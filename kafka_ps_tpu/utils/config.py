@@ -1,0 +1,90 @@
+"""Configuration — the reference's three config tiers collapsed into dataclasses.
+
+Mirrors the hardcoded constants and CLI defaults of the reference
+(BaseKafkaApp.java:25-40, LogisticRegressionTaskSpark.java:32-35,
+ServerAppRunner.java:19-26,59-63, WorkerAppRunner.java:17-24,55-58,
+WorkerSamplingProcessor.java:21-23, ServerProcessor.java:36,44-49),
+but everything the reference hardcodes is configurable here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Consistency-model constants (ServerProcessor.java:44-49):
+#   sequential/BSP == 0, bounded-delay/SSP == k > 0, eventual/ASP == -1.
+SEQUENTIAL = 0
+EVENTUAL = -1
+MAX_DELAY_INFINITY = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """LR task shape (LogisticRegressionTaskSpark.java:32-35).
+
+    The parameter vector is flat with (num_classes + 1) * num_features
+    coefficient keys followed by (num_classes + 1) intercept keys —
+    6*1024 + 6 = 6150 by default.  One extra row because reference labels
+    are 1..num_classes and Spark sizes the model 0..max_label
+    (LogisticRegressionTaskSpark.java:98-104,122-140).
+    """
+
+    num_features: int = 1024
+    num_classes: int = 5
+    num_max_iter: int = 2       # k local solver steps per iteration
+    local_learning_rate: float = 0.5  # step size of the local k-step solver
+
+    @property
+    def num_rows(self) -> int:
+        return self.num_classes + 1
+
+    @property
+    def num_params(self) -> int:
+        return self.num_rows * self.num_features + self.num_rows
+
+
+@dataclasses.dataclass(frozen=True)
+class BufferConfig:
+    """Dynamic sliding-buffer policy (WorkerAppRunner.java:55-58,
+    WorkerSamplingProcessor.java:21-23,115-122)."""
+
+    min_size: int = 128
+    max_size: int = 1024
+    coefficient: float = 0.3      # -bc: target = clamp(bc * events_per_min, min, max)
+    arrival_window: int = 500     # inter-arrival-time window length
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamConfig:
+    """Producer pacing (CsvProducer.java:73-83, ServerAppRunner.java:60)."""
+
+    time_per_event_ms: float = 200.0   # -p: steady-state ms per event
+    prefill_per_worker: int = 128      # first num_workers*128 rows unthrottled
+
+
+@dataclasses.dataclass(frozen=True)
+class PSConfig:
+    """Top-level parameter-server configuration (BaseKafkaApp.java:25,
+    ServerProcessor.java:36,45-49)."""
+
+    num_workers: int = 4
+    consistency_model: int = SEQUENTIAL   # -c: 0 BSP, k>0 SSP, -1 ASP
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    buffer: BufferConfig = dataclasses.field(default_factory=BufferConfig)
+    stream: StreamConfig = dataclasses.field(default_factory=StreamConfig)
+    # Server aggregation rate: 1/num_workers makes the BSP update the
+    # average of worker deltas (ServerProcessor.java:36).
+    learning_rate: float | None = None
+    eval_every: int = 1   # server evaluates test metrics every iteration
+    seed: int = 0
+
+    @property
+    def server_lr(self) -> float:
+        if self.learning_rate is not None:
+            return self.learning_rate
+        return 1.0 / self.num_workers
+
+    @property
+    def max_vector_clock_delay(self) -> int:
+        """ServerProcessor.java:45-49: delay == consistency model value."""
+        return self.consistency_model

@@ -1,0 +1,17 @@
+"""Test env: 8 virtual CPU devices so multi-chip sharding paths are exercised
+without TPU hardware (mirrors the reference's strategy of simulating N logical
+workers in one JVM, BaseKafkaApp.java:25,70 — here N virtual XLA devices in
+one process)."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The environment's TPU plugin may force jax_platforms back to the
+# accelerator at interpreter start; pin CPU before any backend init.
+jax.config.update("jax_platforms", "cpu")
