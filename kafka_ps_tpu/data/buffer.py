@@ -1,0 +1,142 @@
+"""Dynamic sliding training-data buffer — one per logical worker.
+
+Behavioral re-design of the reference's WorkerSamplingProcessor
+(processors/WorkerSamplingProcessor.java:18-136).  The reference stores
+sparse JSON rows in a Kafka Streams KV store keyed into a per-worker key
+space; here each worker owns **fixed-capacity dense numpy arrays plus a
+validity mask** — static shapes so the jit'd training step never
+recompiles, and the device transfer is one contiguous slab instead of a
+per-row range scan.
+
+Policy preserved exactly:
+  * inter-arrival times tracked over a 500-event window
+    (WorkerSamplingProcessor.java:21-23,124-135);
+  * target size = clamp(round(coefficient * events_per_minute), min, max)
+    with events_per_minute = 60000 / mean_inter_arrival_ms, default mean
+    1000 ms before any samples (WorkerSamplingProcessor.java:115-122);
+  * insertion: below target → fill first empty slot; at target →
+    overwrite oldest; above target (target shrank) → delete the n oldest
+    then overwrite the next-oldest survivor
+    (WorkerSamplingProcessor.java:79-112);
+  * insertion IDs are buffer-relative: new ID = max ID currently in the
+    buffer + 1 (0 when empty) (WorkerSamplingProcessor.java:74-77,110-111).
+
+The reference's buffer-scan off-by-one (training scans one key into the
+next worker's space, SURVEY §3.5.2) is intentionally NOT reproduced —
+each buffer is a private object, so there is no adjacent key space to
+leak into.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+import numpy as np
+
+from kafka_ps_tpu.models.logreg import sparse_to_dense
+from kafka_ps_tpu.utils.config import BufferConfig
+
+
+def _default_clock_ms() -> float:
+    return time.monotonic() * 1000.0
+
+
+class SlidingBuffer:
+    """Fixed-capacity masked ring buffer with a rate-adaptive target size."""
+
+    def __init__(self, num_features: int, cfg: BufferConfig,
+                 clock_ms: Callable[[], float] | None = None):
+        self.cfg = cfg
+        self.num_features = num_features
+        cap = cfg.max_size
+        self.x = np.zeros((cap, num_features), dtype=np.float32)
+        self.y = np.zeros((cap,), dtype=np.int32)
+        # insertion_id[i] == 0 marks an empty slot (reference IDs start at 1).
+        self.insertion_id = np.zeros((cap,), dtype=np.int64)
+        self._clock_ms = clock_ms or _default_clock_ms
+        self._inter_arrival_ms: deque[float] = deque(maxlen=cfg.arrival_window)
+        self._last_arrival_ms: float | None = None
+        # add() and snapshot() are internally synchronized so the producer
+        # thread and the training loop need no external locking.
+        self._lock = threading.Lock()
+
+    # -- rate tracking (WorkerSamplingProcessor.java:124-135) --------------
+
+    def _record_arrival(self) -> None:
+        now = self._clock_ms()
+        if self._last_arrival_ms is not None:
+            self._inter_arrival_ms.append(now - self._last_arrival_ms)
+        self._last_arrival_ms = now
+
+    def target_size(self) -> int:
+        """clamp(round(coefficient * events_per_minute), min, max)."""
+        if self._inter_arrival_ms:
+            mean_ms = sum(self._inter_arrival_ms) / len(self._inter_arrival_ms)
+        else:
+            mean_ms = 1000.0
+        if mean_ms <= 0:
+            # burst arrivals within clock resolution: rate is effectively
+            # infinite, clamp straight to the cap
+            return self.cfg.max_size
+        calculated = round(self.cfg.coefficient * 60000.0 / mean_ms)
+        return max(self.cfg.min_size, min(self.cfg.max_size, int(calculated)))
+
+    # -- insertion policy (WorkerSamplingProcessor.java:79-112) ------------
+
+    def add(self, features, label: int) -> None:
+        """Insert one sample, evicting per the dynamic-target policy."""
+        with self._lock:
+            self._add_locked(features, label)
+
+    def _add_locked(self, features, label: int) -> None:
+        self._record_arrival()
+        target = self.target_size()
+
+        filled = np.flatnonzero(self.insertion_id > 0)
+        count = len(filled)
+        new_id = int(self.insertion_id.max()) + 1 if count else 1
+
+        if count < target:
+            # fill the first empty slot
+            slot = int(np.flatnonzero(self.insertion_id == 0)[0])
+        elif count == target:
+            # overwrite the oldest
+            slot = int(filled[np.argmin(self.insertion_id[filled])])
+        else:
+            # target shrank: drop the n oldest, overwrite the next-oldest
+            n = count - target
+            oldest_first = filled[np.argsort(self.insertion_id[filled])]
+            self.insertion_id[oldest_first[:n]] = 0
+            slot = int(oldest_first[n])
+
+        if isinstance(features, dict):
+            row = sparse_to_dense([features], self.num_features)[0]
+        else:
+            row = np.asarray(features, dtype=np.float32)
+        self.x[slot] = row
+        self.y[slot] = label
+        self.insertion_id[slot] = new_id
+
+    # -- views for the training step ---------------------------------------
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return int((self.insertion_id > 0).sum())
+
+    @property
+    def num_tuples_seen(self) -> int:
+        """Worker log column: max insertion ID in the buffer
+        (WorkerTrainingProcessor.java:80-92)."""
+        with self._lock:
+            return int(self.insertion_id.max())
+
+    def snapshot(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(x, y, mask) — a consistent copy of the static-shape slab
+        shipped to the device; safe to use without holding any lock."""
+        with self._lock:
+            mask = (self.insertion_id > 0).astype(np.float32)
+            return self.x.copy(), self.y.copy(), mask

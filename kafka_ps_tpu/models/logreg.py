@@ -77,6 +77,29 @@ def loss_fn(params: LogRegParams, x: jax.Array, y: jax.Array,
     return (nll * mask).sum() / denom
 
 
+def grad_loss(theta: jax.Array, x: jax.Array, y: jax.Array, mask: jax.Array,
+              cfg: ModelConfig) -> tuple[jax.Array, jax.Array]:
+    """Closed-form (gradient, loss) of the masked softmax-CE objective.
+
+    Written explicitly (G = (softmax − onehot)·mask/n; ∇W = Gᵀ·x — two
+    MXU matmuls) rather than via `jax.grad` so the same code is safe
+    inside `shard_map` bodies: under shard_map's replication rule, AD
+    cotangents of replicated operands are auto-psum'd across the mesh,
+    which would silently turn a per-worker gradient into the global sum
+    (see tests/test_parallel.py::test_explicit_grad_matches_autodiff).
+    """
+    params = unflatten(theta, cfg)
+    lg = logits(params, x)
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    onehot = jax.nn.one_hot(y, cfg.num_rows, dtype=lg.dtype)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    nll = -(logp * onehot).sum(axis=-1)
+    loss = (nll * mask).sum() / denom
+    g = (jnp.exp(logp) - onehot) * (mask / denom)[:, None]   # [B, C+1]
+    grad = LogRegParams(weights=g.T @ x, intercept=g.sum(axis=0)).flat
+    return grad, loss
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def local_update(theta: jax.Array, x: jax.Array, y: jax.Array, mask: jax.Array,
                  *, cfg: ModelConfig) -> tuple[jax.Array, jax.Array]:
@@ -91,15 +114,15 @@ def local_update(theta: jax.Array, x: jax.Array, y: jax.Array, mask: jax.Array,
     ("k local solver steps, delta exchanged") is what is matched, not
     Spark's line-search trajectory (documented divergence, SURVEY §7).
     """
-    obj = lambda t: loss_fn(unflatten(t, cfg), x, y, mask)
-    grad_fn = jax.grad(obj)
     lr = cfg.local_learning_rate
 
     def step(t, _):
-        return t - lr * grad_fn(t), None
+        g, _ = grad_loss(t, x, y, mask, cfg)
+        return t - lr * g, None
 
     theta_new, _ = jax.lax.scan(step, theta, None, length=cfg.num_max_iter)
-    return theta_new - theta, obj(theta_new)
+    _, final_loss = grad_loss(theta_new, x, y, mask, cfg)
+    return theta_new - theta, final_loss
 
 
 def sparse_to_dense(rows: list[dict[int, float]], num_features: int) -> np.ndarray:

@@ -1,0 +1,43 @@
+"""Device-mesh helpers — the TPU-native replacement for the reference's
+Kafka cluster topology (brokers/partitions → a `jax.sharding.Mesh` of
+chips over ICI).
+
+The canonical mesh is 1-D over a `workers` axis: data parallelism in the
+parameter-server pattern (the reference's single strategy, SURVEY §2.6).
+A second optional `params` axis range-shards the parameter vector —
+honoring the reference's latent KeyRange design (messages/KeyRange.java,
+always full-range there) the TPU way (reduce_scatter / all_gather).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+WORKER_AXIS = "workers"
+PARAM_AXIS = "params"
+
+
+def worker_mesh(num_devices: int | None = None,
+                devices: list | None = None) -> Mesh:
+    """1-D mesh over the worker axis (data parallelism over ICI)."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (WORKER_AXIS,))
+
+
+def worker_param_mesh(num_worker_shards: int, num_param_shards: int,
+                      devices: list | None = None) -> Mesh:
+    """2-D mesh: data parallelism × parameter-range sharding (the
+    KeyRange axis made real)."""
+    if devices is None:
+        devices = jax.devices()
+    need = num_worker_shards * num_param_shards
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(num_worker_shards,
+                                             num_param_shards)
+    return Mesh(arr, (WORKER_AXIS, PARAM_AXIS))

@@ -1,0 +1,198 @@
+"""System assembly + drive loops — the reference's apps/ layer
+(BaseKafkaApp/ServerApp/WorkerApp topologies, BaseKafkaApp.java:23-87)
+without the broker.
+
+Wires: CSV stream producer → per-worker sliding buffers (the INPUT_DATA
+hop), WorkerNodes ↔ ServerNode over the in-process fabric (the
+WEIGHTS/GRADIENTS hops), with three drive modes:
+
+  * `run_serial` — deterministic single-thread scheduler (the test
+    harness the reference never built, SURVEY §4);
+  * `run_threaded` — one thread per worker + server on the main thread,
+    mirroring the reference's 4 stream threads (BaseKafkaApp.java:70);
+    real wall-clock overlap for the async consistency models via JAX
+    async dispatch;
+  * `run_fused_bsp` — the TPU-native fast path for the sequential model:
+    whole iterations as single jit'd shard_map steps (parallel/bsp.py),
+    buffers re-slabbed between steps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from kafka_ps_tpu.data.buffer import SlidingBuffer
+from kafka_ps_tpu.data.stream import CsvStreamProducer
+from kafka_ps_tpu.parallel import bsp
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime.server import LogSink, ServerNode
+from kafka_ps_tpu.runtime.worker import WorkerNode
+from kafka_ps_tpu.utils.config import PSConfig, SEQUENTIAL
+
+
+class StreamingPSApp:
+    """One process hosting the server + N logical workers, like the
+    reference's single-JVM local deployment (SURVEY §4)."""
+
+    def __init__(self, cfg: PSConfig,
+                 test_x: np.ndarray | None = None,
+                 test_y: np.ndarray | None = None,
+                 server_log: LogSink | None = None,
+                 worker_log: LogSink | None = None,
+                 clock_ms=None):
+        self.cfg = cfg
+        self.fabric = fabric_mod.Fabric()
+        self.buffers = [
+            SlidingBuffer(cfg.model.num_features, cfg.buffer, clock_ms=clock_ms)
+            for _ in range(cfg.num_workers)]
+        self.server = ServerNode(cfg, self.fabric, test_x, test_y, server_log)
+        self.workers = [
+            WorkerNode(w, cfg, self.fabric, self.buffers[w], test_x, test_y,
+                       worker_log)
+            for w in range(cfg.num_workers)]
+        self._stop = threading.Event()
+
+    # -- ingestion sink (the INPUT_DATA topic hop) -------------------------
+
+    def data_sink(self, worker: int, features: dict[int, float],
+                  label: int) -> None:
+        self.buffers[worker].add(features, label)
+
+    def make_producer(self, csv_path: str, has_header: bool = True,
+                      sleep=time.sleep) -> CsvStreamProducer:
+        return CsvStreamProducer(
+            csv_path, self.cfg.num_workers, self.data_sink,
+            time_per_event_ms=self.cfg.stream.time_per_event_ms,
+            prefill_per_worker=self.cfg.stream.prefill_per_worker,
+            has_header=has_header, sleep=sleep)
+
+    def wait_for_prefill(self, min_per_worker: int = 1,
+                         timeout: float = 60.0) -> None:
+        """The reference sleeps 20 s after starting the producer
+        (ServerAppRunner.java:95); we wait on the actual invariant."""
+        deadline = time.monotonic() + timeout
+        while any(b.count < min_per_worker for b in self.buffers):
+            if time.monotonic() > deadline:
+                raise TimeoutError("buffers not prefilled in time")
+            time.sleep(0.01)
+
+    # -- drive loops -------------------------------------------------------
+
+    def run_serial(self, max_server_iterations: int,
+                   pump=None) -> None:
+        """Deterministic scheduler: alternate weights delivery / gradient
+        processing until the server has applied `max_server_iterations`
+        gradient messages.  `pump()` (optional) feeds more stream rows
+        between rounds."""
+        self.server.start_training_loop()
+        stalled_rounds = 0
+        while self.server.iterations < max_server_iterations:
+            progressed = False
+            for worker in self.workers:
+                msg = self.fabric.poll(fabric_mod.WEIGHTS_TOPIC,
+                                       worker.worker_id)
+                if msg is not None:
+                    worker.on_weights(msg)
+                    progressed = True
+            while self.server.iterations < max_server_iterations:
+                g = self.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0)
+                if g is None:
+                    break
+                self.server.process(g)
+                progressed = True
+            if pump is not None:
+                pump()
+            # pump() can only add buffer rows, never fabric messages, so a
+            # stretch of unprogressed rounds is a protocol deadlock even
+            # with a pump attached.
+            stalled_rounds = 0 if progressed else stalled_rounds + 1
+            if stalled_rounds > (1000 if pump is not None else 0):
+                raise RuntimeError("deadlock: no deliverable messages")
+
+    def run_threaded(self, max_server_iterations: int,
+                     poll_timeout: float = 0.1) -> None:
+        """One thread per worker (the reference's stream threads); server
+        on the calling thread."""
+        self._stop.clear()
+
+        worker_errors: list[BaseException] = []
+
+        def worker_loop(worker: WorkerNode):
+            try:
+                while not self._stop.is_set():
+                    msg = self.fabric.poll_blocking(
+                        fabric_mod.WEIGHTS_TOPIC, worker.worker_id,
+                        timeout=poll_timeout)
+                    if msg is not None:
+                        worker.on_weights(msg)
+            except BaseException as e:   # surface worker death to the server loop
+                worker_errors.append(e)
+                self._stop.set()
+
+        threads = [threading.Thread(target=worker_loop, args=(w,), daemon=True,
+                                    name=f"worker-{w.worker_id}")
+                   for w in self.workers]
+        for t in threads:
+            t.start()
+        try:
+            self.server.start_training_loop()
+            while self.server.iterations < max_server_iterations:
+                if self._stop.is_set():
+                    break
+                g = self.fabric.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0,
+                                              timeout=poll_timeout)
+                if g is not None:
+                    self.server.process(g)
+        finally:
+            self._stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+        if worker_errors:
+            raise RuntimeError("worker thread failed") from worker_errors[0]
+
+    def run_fused_bsp(self, max_server_iterations: int, mesh=None,
+                      log_metrics: bool = True) -> None:
+        """Sequential consistency as fused shard_map steps.  Each step is
+        one full BSP iteration (all workers advance one clock)."""
+        import jax.numpy as jnp
+
+        if self.cfg.consistency_model != SEQUENTIAL:
+            raise ValueError("fused path implements the sequential model only")
+        step = bsp.make_bsp_step(self.cfg.model, self.cfg.num_workers,
+                                 self.cfg.server_lr, mesh=mesh)
+        theta = jnp.asarray(self.server.theta)
+        clock = 0
+        while self.server.iterations < max_server_iterations:
+            slabs = []
+            for w in range(self.cfg.num_workers):
+                x, y, mask = self.buffers[w].snapshot()
+                if mask.sum() == 0:
+                    raise RuntimeError(
+                        f"There is no data in the buffer of worker {w}")
+                slabs.append((x, y, mask))
+            x = np.stack([s[0] for s in slabs])
+            y = np.stack([s[1] for s in slabs])
+            mask = np.stack([s[2] for s in slabs])
+            if mesh is not None:
+                x, y, mask = bsp.shard_worker_batches(mesh, x, y, mask)
+            theta, _ = step(theta, x, y, mask)
+            clock += 1
+            self.server.iterations += self.cfg.num_workers
+            self.server.theta = np.asarray(theta)
+            for w in range(self.cfg.num_workers):
+                self.server.tracker.tracker[w].vector_clock = clock
+            if log_metrics and self.server.test_x is not None:
+                from kafka_ps_tpu.models import metrics as metrics_mod
+                m = metrics_mod.evaluate(theta, self.server.test_x,
+                                         self.server.test_y,
+                                         cfg=self.cfg.model)
+                self.server.last_metrics = m
+                self.server.log(
+                    f"{int(time.time() * 1000)};-1;{clock};{float(m.loss)};"
+                    f"{float(m.f1)};{float(m.accuracy)}")
+
+    def stop(self) -> None:
+        self._stop.set()

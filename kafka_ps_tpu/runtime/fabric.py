@@ -1,0 +1,65 @@
+"""In-process message fabric — the semantic stand-in for the Kafka topics.
+
+The reference's only inter-process transport is three Kafka topics
+(BaseKafkaApp.java:27-33): WEIGHTS (point-to-point by worker key),
+GRADIENTS (many-to-one gather, 1 partition, ServerApp.java:38) and
+INPUT_DATA (data distribution).  The properties the consistency models
+rely on — addressed delivery, per-key FIFO ordering, asynchronous
+buffering that lets workers run unsynchronized — are preserved by plain
+thread-safe deques.  On TPU the payload hops this fabric carries are the
+host-side control plane only; the actual tensors move host↔device via
+`device_put` and device↔device via ICI collectives (parallel/bsp.py).
+
+Doubles as the deterministic test harness the reference declared a
+dependency for but never used (kafka-streams-test-utils, build.gradle:51
+— SURVEY §4): tests drive `poll` directly for fully deterministic
+scheduling.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any
+
+WEIGHTS_TOPIC = "weights"
+GRADIENTS_TOPIC = "gradients"
+INPUT_DATA_TOPIC = "input-data"
+
+
+class Fabric:
+    """Keyed FIFO queues with blocking and non-blocking consumption."""
+
+    def __init__(self):
+        self._queues: dict[tuple[str, int], deque] = {}
+        self._cond = threading.Condition()
+
+    def _q(self, topic: str, key: int) -> deque:
+        return self._queues.setdefault((topic, key), deque())
+
+    def send(self, topic: str, key: int, message: Any) -> None:
+        with self._cond:
+            self._q(topic, key).append(message)
+            self._cond.notify_all()
+
+    def poll(self, topic: str, key: int = 0) -> Any | None:
+        """Non-blocking: next message for (topic, key) or None."""
+        with self._cond:
+            q = self._q(topic, key)
+            return q.popleft() if q else None
+
+    def poll_blocking(self, topic: str, key: int = 0,
+                      timeout: float | None = None) -> Any | None:
+        with self._cond:
+            q = self._q(topic, key)
+            if not q:
+                self._cond.wait_for(lambda: bool(q), timeout=timeout)
+            return q.popleft() if q else None
+
+    def pending(self, topic: str, key: int = 0) -> int:
+        with self._cond:
+            return len(self._q(topic, key))
+
+    def total_pending(self, topic: str) -> int:
+        with self._cond:
+            return sum(len(q) for (t, _), q in self._queues.items() if t == topic)

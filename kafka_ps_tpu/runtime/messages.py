@@ -1,0 +1,83 @@
+"""Wire & state schema — the reference's messages/ package, TPU-first.
+
+The reference addresses parameters as a JSON map of integer key → float
+(BaseMessage.java:29-32, SerializableHashMap.java:7-8).  Here `values`
+is a **dense numpy slab over a contiguous KeyRange** — the PS key-value
+contract survives (keys are positions in the flat 6150-key parameter
+vector, range-sharded servers stay expressible), but a message body is
+one contiguous buffer that `device_put` ships without any host-side
+marshalling.
+
+KeyRange is half-open [start, end) — the reference mixes inclusive and
+exclusive conventions (server end = max+1, ServerProcessor.java:198-208;
+worker end = max, WorkerTrainingProcessor.java:105-109 — the §3.5.1
+off-by-one that drops the last intercept).  We standardise on half-open
+everywhere and do NOT reproduce that quirk.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyRange:
+    """Half-open [start, end) span of flat parameter keys
+    (messages/KeyRange.java, made exclusive)."""
+
+    start: int
+    end: int
+
+    def __post_init__(self):
+        if self.start < 0 or self.end < self.start:
+            raise ValueError(f"invalid KeyRange [{self.start}, {self.end})")
+
+    def contains(self, key: int) -> bool:
+        return self.start <= key < self.end
+
+    def __len__(self) -> int:
+        return self.end - self.start
+
+
+@dataclasses.dataclass(frozen=True)
+class BaseMessage:
+    """vector clock + key range + dense values (BaseMessage.java:17-32)."""
+
+    vector_clock: int
+    key_range: KeyRange
+    values: np.ndarray
+
+    def __post_init__(self):
+        if len(self.values) != len(self.key_range):
+            raise ValueError(
+                f"values length {len(self.values)} != key range "
+                f"[{self.key_range.start}, {self.key_range.end})")
+
+    def get_value(self, key: int) -> float | None:
+        """Point lookup kept for KeyRange-API parity (BaseMessage.java:51-57)."""
+        if not self.key_range.contains(key):
+            return None
+        return float(self.values[key - self.key_range.start])
+
+
+@dataclasses.dataclass(frozen=True)
+class WeightsMessage(BaseMessage):
+    """server → worker (WeightsMessage.java)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class GradientMessage(BaseMessage):
+    """worker → server; carries the sending worker's id
+    (GradientMessage.java:13-16)."""
+
+    worker_id: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class LabeledData:
+    """One streamed sample: sparse features + label (LabeledData.java:14-28)."""
+
+    features: dict[int, float]
+    label: int

@@ -1,0 +1,91 @@
+"""Worker compute node — behavioral re-design of WorkerTrainingProcessor
+(processors/WorkerTrainingProcessor.java:24-138).
+
+On each WeightsMessage: overwrite local parameters with the server's,
+snapshot the worker's sliding buffer (a static-shape masked slab — no
+per-row range scan), run the jit'd k-step local update on device, log
+the worker CSV line, and send the delta back as a GradientMessage with
+the same vector clock on the gather topic.
+
+The reference's empty-buffer invariant (IllegalStateException,
+WorkerTrainingProcessor.java:131-133) is preserved as RuntimeError.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from kafka_ps_tpu.data.buffer import SlidingBuffer
+from kafka_ps_tpu.models import logreg
+from kafka_ps_tpu.models import metrics as metrics_mod
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime.messages import GradientMessage, KeyRange, WeightsMessage
+from kafka_ps_tpu.utils.config import PSConfig
+
+LogSink = Callable[[str], None]
+
+
+class WorkerNode:
+    """One logical worker: private buffer + full model replica + jit'd
+    local solver."""
+
+    def __init__(self, worker_id: int, cfg: PSConfig, fabric: fabric_mod.Fabric,
+                 buffer: SlidingBuffer,
+                 test_x: np.ndarray | None = None,
+                 test_y: np.ndarray | None = None,
+                 log: LogSink | None = None):
+        self.worker_id = worker_id
+        self.cfg = cfg
+        self.fabric = fabric
+        self.buffer = buffer
+        self.theta = np.zeros((cfg.model.num_params,), dtype=np.float32)
+        self.test_x = jnp.asarray(test_x) if test_x is not None else None
+        self.test_y = jnp.asarray(test_y) if test_y is not None else None
+        self.log = log or (lambda line: None)
+        self.iterations = 0
+
+    def on_weights(self, msg: WeightsMessage) -> None:
+        # Overwrite the local replica with the server's parameters
+        # (WorkerTrainingProcessor.java:72).
+        r = msg.key_range
+        self.theta[r.start:r.end] = msg.values
+
+        x, y, mask = self.buffer.snapshot()
+        if mask.sum() == 0:
+            # Empty-buffer invariant (WorkerTrainingProcessor.java:131-133).
+            raise RuntimeError(
+                f"There is no data in the buffer of worker {self.worker_id}")
+
+        delta, loss = logreg.local_update(
+            jnp.asarray(self.theta), jnp.asarray(x), jnp.asarray(y),
+            jnp.asarray(mask), cfg=self.cfg.model)
+        delta = np.asarray(delta)
+
+        # Post-fit test metrics, like the reference's per-iteration eval
+        # inside calculateGradients (LogisticRegressionTaskSpark.java:186).
+        f1, acc = -1.0, -1.0
+        if self.test_x is not None:
+            m = metrics_mod.evaluate(jnp.asarray(self.theta + delta),
+                                     self.test_x, self.test_y,
+                                     cfg=self.cfg.model)
+            f1, acc = float(m.f1), float(m.accuracy)
+
+        # schema: timestamp;partition;vectorClock;loss;fMeasure;accuracy;
+        # numTuplesSeen (WorkerAppRunner.java:80,
+        # WorkerTrainingProcessor.java:85-92)
+        self.log(f"{int(time.time() * 1000)};{self.worker_id};"
+                 f"{msg.vector_clock};{float(loss)};{f1};{acc};"
+                 f"{self.buffer.num_tuples_seen}")
+        self.iterations += 1
+
+        self.fabric.send(
+            fabric_mod.GRADIENTS_TOPIC, 0,
+            GradientMessage(
+                vector_clock=msg.vector_clock,
+                key_range=KeyRange(0, self.cfg.model.num_params),
+                values=delta,
+                worker_id=self.worker_id))

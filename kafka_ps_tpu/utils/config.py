@@ -12,10 +12,10 @@ from __future__ import annotations
 import dataclasses
 
 # Consistency-model constants (ServerProcessor.java:44-49):
-#   sequential/BSP == 0, bounded-delay/SSP == k > 0, eventual/ASP == -1.
+#   sequential/BSP == 0, bounded-delay/SSP == k > 0, eventual/ASP == -1
+#   (the reference's MAX_DELAY_INFINITY sentinel == the eventual model).
 SEQUENTIAL = 0
 EVENTUAL = -1
-MAX_DELAY_INFINITY = -1
 
 
 @dataclasses.dataclass(frozen=True)

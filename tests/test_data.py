@@ -1,0 +1,143 @@
+"""Data-layer tests: the three buffer eviction branches, rate-adaptive
+target sizing with a fake clock, insertion-ID semantics, CSV parsing and
+producer pacing/round-robin."""
+
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.data.buffer import SlidingBuffer
+from kafka_ps_tpu.data.stream import CsvStreamProducer, iter_csv_rows
+from kafka_ps_tpu.utils.config import BufferConfig
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, ms):
+        self.t += ms
+
+    def __call__(self):
+        return self.t
+
+
+def _buffer(min_size=2, max_size=8, coeff=0.3, window=500):
+    clock = FakeClock()
+    buf = SlidingBuffer(
+        num_features=4,
+        cfg=BufferConfig(min_size=min_size, max_size=max_size,
+                         coefficient=coeff, arrival_window=window),
+        clock_ms=clock)
+    return buf, clock
+
+
+def _add(buf, clock, label, dt_ms=1000.0):
+    clock.advance(dt_ms)
+    buf.add({0: float(label)}, label)
+
+
+def test_default_target_before_samples():
+    """No inter-arrival samples → mean 1000 ms → 60 events/min →
+    round(0.3*60)=18, clamped (WorkerSamplingProcessor.java:115-122)."""
+    buf, _ = _buffer(min_size=2, max_size=100)
+    assert buf.target_size() == 18
+    buf_lo, _ = _buffer(min_size=30, max_size=100)
+    assert buf_lo.target_size() == 30  # clamped up
+    buf_hi, _ = _buffer(min_size=2, max_size=10)
+    assert buf_hi.target_size() == 10  # clamped down
+
+
+def test_fill_branch_first_empty_slot():
+    buf, clock = _buffer(min_size=4, max_size=8)
+    for i in range(3):
+        _add(buf, clock, i + 1)
+    assert buf.count == 3
+    # slots filled in order, IDs 1,2,3
+    np.testing.assert_array_equal(buf.insertion_id[:4], [1, 2, 3, 0])
+    assert buf.num_tuples_seen == 3
+
+
+def test_overwrite_oldest_branch():
+    """At target: oldest insertion ID is overwritten in place."""
+    buf, clock = _buffer(min_size=2, max_size=4, coeff=0.3)
+    # 1000ms cadence → target = max(2, min(4, round(0.3*60)=18)) = 4
+    for i in range(4):
+        _add(buf, clock, i + 1)
+    assert buf.count == 4
+    _add(buf, clock, 5)
+    assert buf.count == 4
+    # slot 0 held ID 1 (oldest) → replaced by ID 5
+    assert buf.insertion_id[0] == 5
+    assert buf.y[0] == 5
+    assert sorted(buf.insertion_id.tolist()) == [2, 3, 4, 5]
+
+
+def test_shrink_branch_deletes_n_oldest():
+    """Target shrank below fill level: delete n oldest, overwrite next-oldest
+    (WorkerSamplingProcessor.java:95-107)."""
+    buf, clock = _buffer(min_size=2, max_size=8, coeff=0.3)
+    # fast arrivals: 100 ms → 600/min → target 8 (clamped to max)
+    for i in range(8):
+        _add(buf, clock, i + 1, dt_ms=100.0)
+    assert buf.count == 8
+    # now slow arrivals drag the mean up: window mean rises → target drops.
+    # 7 samples @100ms; add @ 10_000ms each → mean climbs
+    _add(buf, clock, 9, dt_ms=100_000.0)
+    # mean inter-arrival = (7*100 + 100000)/8 = 12587.5ms → 4.77/min
+    # → round(0.3*4.77)=1 → clamped to min_size=2
+    # count(8) > target(2): delete 6 oldest (IDs 1..6), overwrite ID 7's slot
+    assert buf.count == 2
+    remaining = sorted(i for i in buf.insertion_id.tolist() if i > 0)
+    assert remaining == [8, 9]
+
+
+def test_insertion_ids_buffer_relative():
+    """New ID = max surviving ID + 1, like the reference's
+    largestInsertionID+1 (WorkerSamplingProcessor.java:74-77,110-111)."""
+    buf, clock = _buffer(min_size=2, max_size=4)
+    for i in range(6):
+        _add(buf, clock, i)
+    assert buf.num_tuples_seen == 6
+
+
+def test_snapshot_mask():
+    buf, clock = _buffer(min_size=4, max_size=8)
+    _add(buf, clock, 3)
+    _add(buf, clock, 4)
+    x, y, mask = buf.snapshot()
+    assert x.shape == (8, 4) and mask.sum() == 2
+    assert y[0] == 3 and y[1] == 4
+    assert x[0, 0] == 3.0  # sparse dict densified
+
+
+def test_iter_csv_rows_sparse_and_label(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("h0,h1,h2,label\n1.5,0,2,3\n0,0,0,1\n")
+    rows = list(iter_csv_rows(str(p), has_header=True))
+    assert rows == [({0: 1.5, 2: 2.0}, 3), ({}, 1)]
+
+
+def test_iter_csv_rows_validates_width(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("1,2,3\n")
+    with pytest.raises(ValueError, match="expected 5"):
+        list(iter_csv_rows(str(p), has_header=False, num_features=4))
+
+
+def test_producer_round_robin_and_pacing(tmp_path):
+    p = tmp_path / "d.csv"
+    n = 24
+    p.write_text("a,b,y\n" + "\n".join(f"{i},1,0" for i in range(n)) + "\n")
+    got, sleeps = [], []
+    prod = CsvStreamProducer(
+        str(p), num_workers=4,
+        sink=lambda w, f, l: got.append(w),
+        time_per_event_ms=200.0,   # 5 rows per 1s sleep
+        prefill_per_worker=4,      # 16 rows unthrottled
+        sleep=sleeps.append)
+    prod.run()
+    assert got == [i % 4 for i in range(n)]
+    # sleeps at rows 20 (first multiple of 5 at/after prefill 16)... every 5th
+    assert len(sleeps) == 1  # row 20 only (24 rows: multiples of 5 ≥16: 20)
+    assert prod.finished.is_set()
+    assert prod.rows_sent == n

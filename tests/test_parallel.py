@@ -1,0 +1,123 @@
+"""Parallel-path tests: fused shard_map BSP over the 8-virtual-device CPU
+mesh, equivalence with the message-driven sequential path, and mesh
+helpers."""
+
+import jax
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.parallel import bsp, mesh as mesh_mod
+from kafka_ps_tpu.runtime.app import StreamingPSApp
+from kafka_ps_tpu.utils.config import BufferConfig, ModelConfig, PSConfig
+
+from tests.test_runtime import build_app, fill_buffers, make_dataset, small_cfg
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+    assert jax.devices()[0].platform == "cpu"
+
+
+def test_worker_mesh():
+    m = mesh_mod.worker_mesh()
+    assert m.devices.size == 8 and m.axis_names == (mesh_mod.WORKER_AXIS,)
+    m4 = mesh_mod.worker_mesh(num_devices=4)
+    assert m4.devices.size == 4
+
+
+def test_worker_param_mesh():
+    m = mesh_mod.worker_param_mesh(4, 2)
+    assert m.axis_names == (mesh_mod.WORKER_AXIS, mesh_mod.PARAM_AXIS)
+    assert m.devices.shape == (4, 2)
+    with pytest.raises(ValueError, match="need 16 devices"):
+        mesh_mod.worker_param_mesh(4, 4)
+
+
+def _stacked_slabs(app):
+    slabs = [b.snapshot() for b in app.buffers]
+    return (np.stack([s[0] for s in slabs]),
+            np.stack([s[1] for s in slabs]),
+            np.stack([s[2] for s in slabs]))
+
+
+def test_fused_bsp_matches_message_path():
+    """One fused shard_map step == one full message-driven BSP round."""
+    app_msg, _, _ = build_app(0)
+    app_fused, _, _ = build_app(0)
+
+    # message path: one full round (4 gradient messages)
+    app_msg.run_serial(max_server_iterations=4)
+
+    m = mesh_mod.worker_mesh(num_devices=4)
+    step = bsp.make_bsp_step(app_fused.cfg.model, 4,
+                             app_fused.cfg.server_lr, mesh=m)
+    x, y, mask = _stacked_slabs(app_fused)
+    x, y, mask = bsp.shard_worker_batches(m, x, y, mask)
+    theta, _ = step(jax.numpy.asarray(app_fused.server.theta), x, y, mask)
+
+    np.testing.assert_allclose(np.asarray(theta), app_msg.server.theta,
+                               atol=2e-5)
+
+
+def test_fused_bsp_vmap_fallback_matches_mesh():
+    """Fewer devices than workers → vmap fallback; same math."""
+    app, _, _ = build_app(0)
+    x, y, mask = _stacked_slabs(app)
+    theta0 = jax.numpy.asarray(app.server.theta)
+
+    m = mesh_mod.worker_mesh(num_devices=4)
+    step_mesh = bsp.make_bsp_step(app.cfg.model, 4, app.cfg.server_lr, mesh=m)
+    xs, ys, ms = bsp.shard_worker_batches(m, x, y, mask)
+    t_mesh, loss_mesh = step_mesh(theta0, xs, ys, ms)
+
+    step_vmap = bsp.make_bsp_step(app.cfg.model, 4, app.cfg.server_lr)
+    t_vmap, loss_vmap = step_vmap(theta0, x, y, mask)
+
+    np.testing.assert_allclose(np.asarray(t_mesh), np.asarray(t_vmap),
+                               atol=2e-5)
+    assert float(loss_mesh) == pytest.approx(float(loss_vmap), rel=1e-4)
+
+
+def test_fused_bsp_eight_workers_eight_devices():
+    cfg = small_cfg(0, num_workers=8)
+    x, y = make_dataset(512)
+    app = StreamingPSApp(cfg, test_x=x, test_y=y)
+    fill_buffers(app, x, y)
+    m = mesh_mod.worker_mesh()
+    app.run_fused_bsp(max_server_iterations=8 * 10, mesh=m)
+    assert float(app.server.last_metrics.accuracy) > 0.9
+
+
+def test_explicit_grad_matches_autodiff():
+    """grad_loss (closed form) == jax.grad of loss_fn — and the reason it
+    exists: under shard_map, AD cotangents of replicated operands are
+    auto-psum'd, corrupting per-worker gradients."""
+    import jax.numpy as jnp
+    from kafka_ps_tpu.models import logreg
+
+    cfg = ModelConfig(num_features=8, num_classes=2)
+    x, y = make_dataset(32)
+    mask = np.ones(32, np.float32)
+    mask[20:] = 0.0
+    rng = np.random.default_rng(7)
+    theta = jnp.asarray(rng.normal(size=cfg.num_params).astype(np.float32))
+    g_exp, loss_exp = logreg.grad_loss(theta, jnp.asarray(x), jnp.asarray(y),
+                                       jnp.asarray(mask), cfg)
+    obj = lambda t: logreg.loss_fn(logreg.unflatten(t, cfg), jnp.asarray(x),
+                                   jnp.asarray(y), jnp.asarray(mask))
+    g_ad = jax.grad(obj)(theta)
+    np.testing.assert_allclose(np.asarray(g_exp), np.asarray(g_ad), atol=1e-5)
+    assert float(loss_exp) == pytest.approx(float(obj(theta)), rel=1e-5)
+
+
+def test_fused_rejects_nonmultiple_workers():
+    m = mesh_mod.worker_mesh()
+    with pytest.raises(ValueError, match="multiple"):
+        bsp.make_bsp_step(ModelConfig(num_features=4, num_classes=2), 3,
+                          1 / 3, mesh=m)
+
+
+def test_fused_app_requires_sequential():
+    app, _, _ = build_app(3)
+    with pytest.raises(ValueError, match="sequential"):
+        app.run_fused_bsp(max_server_iterations=4)

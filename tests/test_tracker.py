@@ -1,0 +1,77 @@
+"""MessageTracker gating-predicate tests — the subtle heart of the
+consistency models (reference MessageTracker.java:10-88)."""
+
+import pytest
+
+from kafka_ps_tpu.parallel.tracker import MessageTracker
+
+
+def test_initial_state():
+    t = MessageTracker(4)
+    assert t.clocks == [0, 0, 0, 0]
+    # bootstrap broadcast counts as sent
+    assert all(s.weights_message_sent for s in t.tracker)
+
+
+def test_received_increments_and_asserts():
+    t = MessageTracker(2)
+    t.received_message(0, 0)
+    assert t.clocks == [1, 0]
+    assert not t.tracker[0].weights_message_sent
+    with pytest.raises(ValueError, match="Expected value 1, actual value 0"):
+        t.received_message(0, 0)
+
+
+def test_sent_is_idempotent_at_same_clock():
+    t = MessageTracker(2)
+    t.received_message(0, 0)
+    t.sent_message(0, 1)
+    t.sent_message(0, 1)  # second mark at same clock is fine (reference :22-27)
+    with pytest.raises(ValueError):
+        t.sent_message(0, 2)
+
+
+def test_has_received_all_messages():
+    t = MessageTracker(3)
+    # min clock >= vc+1 (MessageTracker.java:81-87)
+    assert t.has_received_all_messages(-1)
+    assert not t.has_received_all_messages(0)
+    for w in range(3):
+        t.received_message(w, 0)
+    assert t.has_received_all_messages(0)
+    assert not t.has_received_all_messages(1)
+
+
+def test_sendable_messages_bounded_delay():
+    """Worker w is sendable iff reply pending and min_clock >= clock_w - delay
+    (MessageTracker.java:69-79)."""
+    t = MessageTracker(3)
+    delay = 2
+    # worker 0 races ahead to clock 3; workers 1,2 stay at 0
+    t.received_message(0, 0)
+    assert t.get_all_sendable_messages(delay) == [(0, 1)]
+    t.sent_message(0, 1)
+    t.received_message(0, 1)
+    assert t.get_all_sendable_messages(delay) == [(0, 2)]
+    t.sent_message(0, 2)
+    t.received_message(0, 2)
+    # clock_0 = 3; 3 - 2 - 1 = 0; has_received_all(0) = (min=0 >= 1) false
+    assert t.get_all_sendable_messages(delay) == []
+    # worker 1 catches up one step → min still 0 (worker 2)
+    t.received_message(1, 0)
+    assert t.get_all_sendable_messages(delay) == [(1, 1)]
+    # worker 2 delivers → min clock 1 → worker 0 (clock 3) now within delay
+    t.received_message(2, 0)
+    got = sorted(t.get_all_sendable_messages(delay))
+    assert got == [(0, 3), (1, 1), (2, 1)]
+
+
+def test_sent_all_messages_requires_uniform_clock():
+    t = MessageTracker(2)
+    for w in range(2):
+        t.received_message(w, 0)
+    t.sent_all_messages(1)
+    assert all(s.weights_message_sent for s in t.tracker)
+    t.received_message(0, 1)
+    with pytest.raises(ValueError):
+        t.sent_all_messages(2)  # worker 1 still at clock 1
