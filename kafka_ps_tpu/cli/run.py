@@ -1,0 +1,171 @@
+"""Canonical CLI — the whole streaming PS system in one process.
+
+The reference splits server and worker into two JVMs because Kafka is
+the transport (run.sh:10-18); on TPU one host process owns every device,
+so this runner hosts producer + server + N logical workers together.
+`cli/server_runner.py` and `cli/worker_runner.py` keep the reference's
+per-role flag surfaces and delegate here.
+
+Flags are the union of ServerAppRunner.java:19-26 and
+WorkerAppRunner.java:17-24, same names and defaults; TPU-native extras
+are prefixed with `--`-only long names.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def build_parser(include_server_flags: bool = True,
+                 include_worker_flags: bool = True,
+                 prog: str = "kafka_ps_tpu") -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog=prog, description="TPU-native streaming parameter server")
+    if include_server_flags:
+        p.add_argument("-training", "--training_data_file_path",
+                       default="./data/train.csv",
+                       help="path to the training-data CSV "
+                            "(BaseKafkaApp.java:35)")
+        p.add_argument("-c", "--consistency_model", type=int, default=0,
+                       help="0 sequential, k>0 bounded delay, -1 eventual")
+        p.add_argument("-p", "--producer_time_per_event", type=int,
+                       default=200, help="ms per produced event")
+    if include_worker_flags:
+        p.add_argument("-min", "--min_buffer_size", type=int, default=128)
+        p.add_argument("-max", "--max_buffer_size", type=int, default=1024)
+        p.add_argument("-bc", "--buffer_size_coefficient", type=float,
+                       default=0.3)
+    p.add_argument("-test", "--test_data_file_path",
+                   default="./data/test.csv",
+                   help="path to the test-data CSV (BaseKafkaApp.java:36)")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="print the parameters that are used")
+    p.add_argument("-r", "--remote", action="store_true",
+                   help="reference: remote Kafka broker; here: reserved "
+                        "for multi-host (DCN) deployment")
+    p.add_argument("-l", "--logging", action="store_true",
+                   help="write performance logs to ./logs-server.csv / "
+                        "./logs-worker.csv instead of stdout")
+    # TPU-native extras
+    p.add_argument("--num_workers", type=int, default=4,
+                   help="logical workers (reference hardcodes 4, "
+                        "BaseKafkaApp.java:25)")
+    p.add_argument("--num_features", type=int, default=1024)
+    p.add_argument("--num_classes", type=int, default=5)
+    p.add_argument("--local_iterations", type=int, default=2,
+                   help="k local solver steps per iteration "
+                        "(numMaxIter, LogisticRegressionTaskSpark.java:35)")
+    p.add_argument("--local_learning_rate", type=float, default=0.5)
+    p.add_argument("--max_iterations", type=int, default=0,
+                   help="stop after this many server iterations "
+                        "(0 = run until Ctrl-C, like the reference)")
+    p.add_argument("--fused", action="store_true",
+                   help="sequential model as fused shard_map steps "
+                        "(TPU fast path)")
+    p.add_argument("--mode", choices=["threaded", "serial"],
+                   default="threaded")
+    p.add_argument("--checkpoint", default=None,
+                   help="path to save/restore parameters "
+                        "(improvement over the reference's cold start)")
+    p.add_argument("--checkpoint_every", type=int, default=50,
+                   help="server iterations between checkpoint saves")
+    return p
+
+
+def load_test_csv(path: str, num_features: int):
+    """Test set: dense CSV with header, label in the last column
+    (LogisticRegressionTaskSpark.java:77-92)."""
+    data = np.loadtxt(path, delimiter=",", skiprows=1)
+    if data.ndim == 1:
+        data = data[None, :]
+    if data.shape[1] != num_features + 1:
+        raise SystemExit(
+            f"test CSV has {data.shape[1]} columns, expected "
+            f"{num_features + 1} (features + label)")
+    return data[:, :-1].astype(np.float32), data[:, -1].astype(np.int32)
+
+
+def make_app_from_args(args, resuming: bool = False):
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+    from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
+                                           PSConfig, StreamConfig)
+    from kafka_ps_tpu.utils.csvlog import (CsvLogSink, SERVER_HEADER,
+                                           WORKER_HEADER)
+
+    cfg = PSConfig(
+        num_workers=args.num_workers,
+        consistency_model=args.consistency_model,
+        model=ModelConfig(num_features=args.num_features,
+                          num_classes=args.num_classes,
+                          num_max_iter=args.local_iterations,
+                          local_learning_rate=args.local_learning_rate),
+        buffer=BufferConfig(min_size=args.min_buffer_size,
+                            max_size=args.max_buffer_size,
+                            coefficient=args.buffer_size_coefficient),
+        stream=StreamConfig(time_per_event_ms=args.producer_time_per_event),
+    )
+    test_x, test_y = load_test_csv(args.test_data_file_path,
+                                   args.num_features)
+    server_log = CsvLogSink("./logs-server.csv" if args.logging else None,
+                            SERVER_HEADER, append=resuming)
+    worker_log = CsvLogSink("./logs-worker.csv" if args.logging else None,
+                            WORKER_HEADER, append=resuming)
+    app = StreamingPSApp(cfg, test_x=test_x, test_y=test_y,
+                         server_log=server_log, worker_log=worker_log)
+    return app, (server_log, worker_log)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return run_with_args(args)
+
+
+def run_with_args(args) -> int:
+    if args.verbose:
+        print("\nUsed parameter:")
+        for k, v in sorted(vars(args).items()):
+            print(f"    {k}: {v}")
+
+    import os
+    resuming = bool(args.checkpoint and os.path.exists(args.checkpoint))
+    app, logs = make_app_from_args(args, resuming=resuming)
+
+    if args.checkpoint:
+        from kafka_ps_tpu.utils import checkpoint as ckpt
+        restored = ckpt.maybe_restore(args.checkpoint, app.server)
+        if restored and args.verbose:
+            print(f"    restored checkpoint at iteration "
+                  f"{app.server.iterations}")
+        app.server.checkpoint_path = args.checkpoint
+        app.server.checkpoint_every = args.checkpoint_every
+
+    producer = app.make_producer(args.training_data_file_path)
+    producer.run_in_background()
+    app.wait_for_prefill(min_per_worker=1, timeout=120.0)
+
+    max_iters = args.max_iterations or sys.maxsize
+    try:
+        if args.fused:
+            app.run_fused_bsp(max_server_iterations=max_iters)
+        elif args.mode == "serial":
+            app.run_serial(max_server_iterations=max_iters,
+                           pump=lambda: None)
+        else:
+            app.run_threaded(max_server_iterations=max_iters)
+    except KeyboardInterrupt:
+        print("interrupted — shutting down", file=sys.stderr)
+        app.stop()
+    finally:
+        if args.checkpoint:
+            from kafka_ps_tpu.utils import checkpoint as ckpt
+            ckpt.save(args.checkpoint, app.server)
+        for log in logs:
+            log.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

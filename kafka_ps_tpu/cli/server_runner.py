@@ -1,0 +1,31 @@
+"""Server-role entry point — flag parity with the reference's
+ServerAppRunner (ServerAppRunner.java:14-104: -training -test -c -p
+-v -h -r -l, same defaults).
+
+The reference runs server and workers as separate JVMs coupled through
+Kafka; on TPU one host process owns all devices, so this runner hosts
+the complete system (producer + server + logical workers) with the
+worker-side knobs at their reference defaults.  Use cli/run.py for the
+full flag surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from kafka_ps_tpu.cli import run as run_mod
+
+
+def main(argv=None) -> int:
+    parser = run_mod.build_parser(include_server_flags=True,
+                                  include_worker_flags=False,
+                                  prog="ServerAppRunner")
+    args = parser.parse_args(argv)
+    # worker-side defaults (WorkerAppRunner.java:55-58)
+    args = argparse.Namespace(min_buffer_size=128, max_buffer_size=1024,
+                              buffer_size_coefficient=0.3, **vars(args))
+    return run_mod.run_with_args(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

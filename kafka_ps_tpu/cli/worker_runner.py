@@ -1,0 +1,30 @@
+"""Worker-role entry point — flag parity with the reference's
+WorkerAppRunner (WorkerAppRunner.java:13-96: -test -min -max -bc
+-v -h -r -l, same defaults).
+
+Hosts the complete system with the server-side knobs at their reference
+defaults (consistency 0, producer 200 ms/event) — see
+cli/server_runner.py for why the roles are colocated on TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from kafka_ps_tpu.cli import run as run_mod
+
+
+def main(argv=None) -> int:
+    parser = run_mod.build_parser(include_server_flags=False,
+                                  include_worker_flags=True,
+                                  prog="WorkerAppRunner")
+    args = parser.parse_args(argv)
+    # server-side defaults (ServerAppRunner.java:59-63, BaseKafkaApp.java:35)
+    args = argparse.Namespace(training_data_file_path="./data/train.csv",
+                              consistency_model=0,
+                              producer_time_per_event=200, **vars(args))
+    return run_mod.run_with_args(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,69 @@
+"""Synthetic dataset shaped like the reference's benchmark data.
+
+The reference benchmarks on Amazon fine-food-reviews embedded to 1024
+hashed features with 5 classes, ≤20k tuples per label (README.md:210-216)
+— the actual embedding CSVs are not redistributable (reference
+.MISSING_LARGE_BLOBS).  This generator produces a drop-in shaped stand-in:
+dense float features, labels 1..num_classes in the last column, linearly
+separable per-class structure plus noise so streaming F1 curves behave
+like the published plots (monotone rise toward an offline ceiling).
+
+Usage: python -m kafka_ps_tpu.data.synth --out_dir ./data --rows 20000
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def generate(rows: int, num_features: int = 1024, num_classes: int = 5,
+             noise: float = 2.0, sparsity: float = 0.7,
+             seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """(x, y) with y in 1..num_classes (the reference's label convention,
+    LogisticRegressionTaskSpark.java:122-140)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=1.0, size=(num_classes, num_features))
+    y = rng.integers(1, num_classes + 1, size=rows).astype(np.int32)
+    x = centers[y - 1] + rng.normal(scale=noise, size=(rows, num_features))
+    # zero out a fraction of entries: the reference's hashed-feature CSVs
+    # are sparse and the producer drops zeros (CsvProducer.java:52-57)
+    drop = rng.random(size=x.shape) < sparsity
+    x = np.where(drop, 0.0, x).astype(np.float32)
+    return x, y
+
+
+def write_csv(path: str, x: np.ndarray, y: np.ndarray) -> None:
+    header = ",".join([str(i) for i in range(x.shape[1])] + ["Score"])
+    with open(path, "w") as f:
+        f.write(header + "\n")
+        for i in range(len(x)):
+            f.write(",".join(f"{v:g}" for v in x[i]) + f",{y[i]}\n")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out_dir", default="./data")
+    p.add_argument("--rows", type=int, default=20000)
+    p.add_argument("--test_rows", type=int, default=2000)
+    p.add_argument("--num_features", type=int, default=1024)
+    p.add_argument("--num_classes", type=int, default=5)
+    p.add_argument("--noise", type=float, default=2.0)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    os.makedirs(args.out_dir, exist_ok=True)
+    x, y = generate(args.rows + args.test_rows, args.num_features,
+                    args.num_classes, noise=args.noise, seed=args.seed)
+    write_csv(os.path.join(args.out_dir, "train.csv"),
+              x[:args.rows], y[:args.rows])
+    write_csv(os.path.join(args.out_dir, "test.csv"),
+              x[args.rows:], y[args.rows:])
+    print(f"wrote {args.rows} train + {args.test_rows} test rows to "
+          f"{args.out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -86,6 +86,50 @@ def make_bsp_step(cfg: ModelConfig, num_workers: int, server_lr: float,
     return jax.jit(sharded)
 
 
+def make_bsp_multi_step(cfg: ModelConfig, num_workers: int, server_lr: float,
+                        rounds: int, mesh: Mesh | None = None) -> BspStep:
+    """`rounds` BSP iterations as ONE device program (lax.scan over the
+    fused step) — a single dispatch executes an entire training stretch,
+    eliminating per-iteration host latency entirely.  This is the
+    steady-state inner loop between buffer refreshes: with no new stream
+    arrivals the reference's loop re-trains on the same buffer
+    (WorkerTrainingProcessor.java:63-97), which is exactly a scan."""
+
+    def round_body(theta, x, y, mask, psum_axis: bool):
+        # The scan carry stays axis-invariant: pvary a per-round copy for
+        # the device-local math, psum the delta back to invariance.
+        theta_local = jax.lax.pvary(theta, WORKER_AXIS) if psum_axis else theta
+        deltas, losses = _vmapped_local_updates(theta_local, x, y, mask, cfg)
+        delta_sum, loss_sum = deltas.sum(0), losses.sum()
+        if psum_axis:
+            delta_sum = jax.lax.psum(delta_sum, WORKER_AXIS)
+            loss_sum = jax.lax.psum(loss_sum, WORKER_AXIS)
+        return theta + server_lr * delta_sum, loss_sum / num_workers
+
+    def scanned(theta, x, y, mask, psum_axis):
+        def body(t, _):
+            t2, loss = round_body(t, x, y, mask, psum_axis)
+            return t2, loss
+        return jax.lax.scan(body, theta, None, length=rounds)
+
+    if mesh is None:
+        return jax.jit(partial(scanned, psum_axis=False))
+
+    if num_workers % mesh.devices.size != 0:
+        raise ValueError(
+            f"num_workers {num_workers} must be a multiple of mesh size "
+            f"{mesh.devices.size}")
+
+    def shard_body(theta, x, y, mask):
+        return scanned(theta, x, y, mask, psum_axis=True)
+
+    sharded = jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
+        out_specs=(P(), P()))
+    return jax.jit(sharded)
+
+
 def shard_worker_batches(mesh: Mesh, x, y, mask):
     """Place the stacked per-worker slabs [N, ...] sharded over the worker
     axis so host→device transfer happens once per device, not per worker."""

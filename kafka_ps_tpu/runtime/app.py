@@ -164,7 +164,8 @@ class StreamingPSApp:
         step = bsp.make_bsp_step(self.cfg.model, self.cfg.num_workers,
                                  self.cfg.server_lr, mesh=mesh)
         theta = jnp.asarray(self.server.theta)
-        clock = 0
+        # under BSP all clocks are uniform; resume from the restored one
+        clock = min(self.server.tracker.clocks)
         while self.server.iterations < max_server_iterations:
             slabs = []
             for w in range(self.cfg.num_workers):
@@ -184,6 +185,8 @@ class StreamingPSApp:
             self.server.theta = np.asarray(theta)
             for w in range(self.cfg.num_workers):
                 self.server.tracker.tracker[w].vector_clock = clock
+                self.server.tracker.tracker[w].weights_message_sent = True
+            self.server.maybe_checkpoint()
             if log_metrics and self.server.test_x is not None:
                 from kafka_ps_tpu.models import metrics as metrics_mod
                 m = metrics_mod.evaluate(theta, self.server.test_x,

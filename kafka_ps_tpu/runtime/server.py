@@ -57,15 +57,43 @@ class ServerNode:
         self.log = log or (lambda line: None)
         self.iterations = 0          # total gradient messages applied
         self.last_metrics = None
+        # optional periodic checkpointing (utils/checkpoint.py)
+        self.checkpoint_path: str | None = None
+        self.checkpoint_every: int = 50   # <= 0: only save on exit
+        self._last_checkpoint_iteration = 0
 
     # -- bootstrap (ServerProcessor.java:75-87) ----------------------------
 
     def start_training_loop(self) -> None:
-        """Zero-init weights and broadcast WeightsMessage(vc=0) to every
-        worker — kicks off the self-sustaining loop."""
-        for worker in range(self.cfg.num_workers):
+        """Broadcast WeightsMessages to kick off the self-sustaining loop.
+
+        Cold start: every worker is in the already-replied state (tracker
+        bootstrap, MessageTracker.java:47-53) and gets clock 0, like the
+        reference.  After a checkpoint restore: workers whose reply was
+        delivered get their current clock re-sent (the in-flight message
+        died with the crash); workers with a *withheld* reply go back
+        through the consistency gate — only those currently eligible are
+        re-issued, so restored runs respect the same staleness bounds.
+        """
+        for worker, status in enumerate(self.tracker.tracker):
+            if status.weights_message_sent:
+                self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
+                                 self._weights_message(status.vector_clock))
+        delay = self.cfg.max_vector_clock_delay
+        if delay == EVENTUAL:
+            # eventual answers immediately, so any surviving pending
+            # reply is re-issued at once
+            pending = [(w, s.vector_clock)
+                       for w, s in enumerate(self.tracker.tracker)
+                       if not s.weights_message_sent]
+        else:
+            # sequential == bounded with delay 0: the tracker's own
+            # sendable predicate (MessageTracker.java:69-79)
+            pending = self.tracker.get_all_sendable_messages(max(delay, 0))
+        for worker, clock in pending:
             self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
-                             self._weights_message(0))
+                             self._weights_message(clock))
+            self.tracker.sent_message(worker, clock)
 
     def _weights_message(self, vector_clock: int) -> WeightsMessage:
         return WeightsMessage(
@@ -112,3 +140,17 @@ class ServerNode:
             self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
                              self._weights_message(clock))
             self.tracker.sent_message(worker, clock)
+
+        self.maybe_checkpoint()
+
+    def maybe_checkpoint(self) -> None:
+        """Save once every `checkpoint_every` applied iterations —
+        crossing-based so any iteration stride (1 in the message path,
+        num_workers in the fused path) triggers on schedule."""
+        if not self.checkpoint_path or self.checkpoint_every <= 0:
+            return
+        if (self.iterations - self._last_checkpoint_iteration
+                >= self.checkpoint_every):
+            from kafka_ps_tpu.utils import checkpoint as ckpt
+            ckpt.save(self.checkpoint_path, self)
+            self._last_checkpoint_iteration = self.iterations

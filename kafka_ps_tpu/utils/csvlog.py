@@ -1,0 +1,49 @@
+"""CSV performance logging — the reference's stdout-redirect scheme
+(ServerAppRunner.java:78-82, WorkerAppRunner.java:77-81) as proper sinks.
+
+Schemas (unchanged, so the reference's evaluation notebooks parse our
+logs):
+  server: timestamp;partition;vectorClock;loss;fMeasure;accuracy
+  worker: timestamp;partition;vectorClock;loss;fMeasure;accuracy;numTuplesSeen
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+SERVER_HEADER = "timestamp;partition;vectorClock;loss;fMeasure;accuracy"
+WORKER_HEADER = SERVER_HEADER + ";numTuplesSeen"
+
+
+class CsvLogSink:
+    """Thread-safe line sink to a file (with header) or stdout.
+
+    `append=True` (checkpoint-resumed runs) continues an existing log
+    instead of truncating it; the header is only written when the file
+    is new or empty."""
+
+    def __init__(self, path: str | None, header: str, append: bool = False):
+        import os
+        self._lock = threading.Lock()
+        if path is None:
+            self._fh = sys.stdout
+            self._close = False
+            write_header = True
+        else:
+            exists = os.path.exists(path) and os.path.getsize(path) > 0
+            self._fh = open(path, "a" if append else "w")
+            self._close = True
+            write_header = not (append and exists)
+        if write_header:
+            self._fh.write(header + "\n")
+            self._fh.flush()
+
+    def __call__(self, line: str) -> None:
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._close:
+            self._fh.close()

@@ -1,0 +1,13 @@
+#!/bin/bash
+# TPU-native equivalent of the reference's run.sh (reference run.sh:10-18):
+# the reference launches a worker JVM and a server JVM against a Kafka
+# broker; here one process hosts the whole system on the TPU.
+set -e
+
+if [ ! -f ./data/train.csv ]; then
+  echo "generating synthetic fine-food-shaped dataset into ./data"
+  python -m kafka_ps_tpu.data.synth --out_dir ./data --rows 20000
+fi
+
+# same role flags as the reference: -l (log to CSV), -p 200 (ms/event)
+exec python -m kafka_ps_tpu.cli.run -l -p 200 "$@"

@@ -1,0 +1,228 @@
+"""CLI flag parity, CSV log sinks, checkpoint/resume, synthetic data,
+and the multi-round fused step."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.cli import run as run_mod
+from kafka_ps_tpu.data.synth import generate, write_csv
+from kafka_ps_tpu.parallel import bsp, mesh as mesh_mod
+from kafka_ps_tpu.runtime.app import StreamingPSApp
+from kafka_ps_tpu.utils import checkpoint as ckpt
+from kafka_ps_tpu.utils.config import ModelConfig
+from kafka_ps_tpu.utils.csvlog import CsvLogSink, SERVER_HEADER, WORKER_HEADER
+
+from tests.test_runtime import build_app, small_cfg
+
+
+def test_parser_reference_flags_and_defaults():
+    """Same flags/defaults as ServerAppRunner.java:19-26,59-63 and
+    WorkerAppRunner.java:17-24,55-58."""
+    args = run_mod.build_parser().parse_args([])
+    assert args.training_data_file_path == "./data/train.csv"
+    assert args.test_data_file_path == "./data/test.csv"
+    assert args.consistency_model == 0
+    assert args.producer_time_per_event == 200
+    assert args.min_buffer_size == 128
+    assert args.max_buffer_size == 1024
+    assert args.buffer_size_coefficient == pytest.approx(0.3)
+    assert not args.verbose and not args.remote and not args.logging
+    assert args.num_workers == 4
+
+    args = run_mod.build_parser().parse_args(
+        ["-c", "-1", "-p", "50", "-min", "64", "-max", "256", "-bc", "0.5",
+         "-training", "a.csv", "-test", "b.csv", "-v", "-r", "-l"])
+    assert args.consistency_model == -1
+    assert args.producer_time_per_event == 50
+    assert (args.min_buffer_size, args.max_buffer_size) == (64, 256)
+    assert args.buffer_size_coefficient == pytest.approx(0.5)
+    assert args.training_data_file_path == "a.csv"
+    assert args.verbose and args.remote and args.logging
+
+
+def test_role_runner_flag_surfaces():
+    """server runner: no worker flags; worker runner: no server flags
+    (exact reference role split)."""
+    sp = run_mod.build_parser(include_worker_flags=False)
+    with pytest.raises(SystemExit):
+        sp.parse_args(["-min", "1"])
+    wp = run_mod.build_parser(include_server_flags=False)
+    with pytest.raises(SystemExit):
+        wp.parse_args(["-c", "0"])
+    assert wp.parse_args(["-bc", "0.7"]).buffer_size_coefficient == \
+        pytest.approx(0.7)
+
+
+def test_csvlog_sink(tmp_path):
+    p = tmp_path / "log.csv"
+    sink = CsvLogSink(str(p), SERVER_HEADER)
+    sink("1;2;3;4;5;6")
+    sink.close()
+    lines = p.read_text().splitlines()
+    assert lines == [SERVER_HEADER, "1;2;3;4;5;6"]
+    assert WORKER_HEADER.endswith(";numTuplesSeen")
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    app, _, _ = build_app(0)
+    app.run_serial(max_server_iterations=8)
+    path = str(tmp_path / "ck.npz")
+    ckpt.save(path, app.server)
+
+    app2, _, _ = build_app(0)
+    assert ckpt.maybe_restore(path, app2.server)
+    np.testing.assert_array_equal(app2.server.theta, app.server.theta)
+    assert app2.server.tracker.clocks == app.server.tracker.clocks
+    assert app2.server.iterations == app.server.iterations
+    # resumed app trains onward from the restored clocks without
+    # protocol errors (the bootstrap broadcast re-issues current clocks)
+    start_clock = min(app2.server.tracker.clocks)
+    app2.run_serial(max_server_iterations=app2.server.iterations + 8)
+    assert min(app2.server.tracker.clocks) > start_clock
+
+
+def test_checkpoint_restore_mid_round(tmp_path):
+    """Restoring a checkpoint whose clocks are mid-round (some replies
+    withheld by the gate) must not trip the tracker sanitizer: withheld
+    workers go back through the consistency gate, not the bootstrap
+    broadcast."""
+    app, _, _ = build_app(0)
+    app.run_serial(max_server_iterations=6)   # 6 % 4 != 0 -> mid-round
+    clocks = app.server.tracker.clocks
+    assert max(clocks) != min(clocks)          # genuinely mid-round
+    path = str(tmp_path / "mid.npz")
+    ckpt.save(path, app.server)
+
+    app2, _, _ = build_app(0)
+    ckpt.maybe_restore(path, app2.server)
+    app2.run_serial(max_server_iterations=app2.server.iterations + 12)
+    spread = max(app2.server.tracker.clocks) - min(app2.server.tracker.clocks)
+    assert spread <= 1
+
+
+def test_checkpoint_every_zero_means_exit_only(tmp_path):
+    app, _, _ = build_app(0)
+    app.server.checkpoint_path = str(tmp_path / "never.npz")
+    app.server.checkpoint_every = 0
+    app.run_serial(max_server_iterations=8)    # must not raise / save
+    import os
+    assert not os.path.exists(app.server.checkpoint_path)
+
+
+def test_fused_checkpoints_and_resumes(tmp_path):
+    app, _, _ = build_app(0)
+    app.server.checkpoint_path = str(tmp_path / "fused.npz")
+    app.server.checkpoint_every = 8
+    app.run_fused_bsp(max_server_iterations=16, log_metrics=False)
+    z = np.load(app.server.checkpoint_path)
+    assert int(z["iterations"]) >= 8
+    # resume continues the clock forward
+    app2, _, _ = build_app(0)
+    ckpt.restore(str(tmp_path / "fused.npz"), app2.server)
+    c0 = min(app2.server.tracker.clocks)
+    app2.run_fused_bsp(max_server_iterations=app2.server.iterations + 8,
+                       log_metrics=False)
+    assert min(app2.server.tracker.clocks) > c0
+
+
+def test_csvlog_append_mode(tmp_path):
+    p = tmp_path / "log.csv"
+    s1 = CsvLogSink(str(p), SERVER_HEADER)
+    s1("row1")
+    s1.close()
+    s2 = CsvLogSink(str(p), SERVER_HEADER, append=True)
+    s2("row2")
+    s2.close()
+    lines = p.read_text().splitlines()
+    assert lines == [SERVER_HEADER, "row1", "row2"]  # one header, no loss
+
+
+def test_checkpoint_shape_mismatch(tmp_path):
+    app, _, _ = build_app(0)
+    path = str(tmp_path / "ck.npz")
+    ckpt.save(path, app.server)
+    other = StreamingPSApp(small_cfg(0, num_workers=2))
+    with pytest.raises(ValueError, match="worker count"):
+        ckpt.restore(path, other.server)
+
+
+def test_maybe_restore_missing(tmp_path):
+    app, _, _ = build_app(0)
+    assert not ckpt.maybe_restore(str(tmp_path / "nope.npz"), app.server)
+
+
+def test_synth_dataset_shape_and_labels(tmp_path):
+    x, y = generate(100, num_features=32, num_classes=5, seed=3)
+    assert x.shape == (100, 32) and x.dtype == np.float32
+    assert set(np.unique(y)) <= set(range(1, 6))
+    assert (x == 0).mean() > 0.5  # sparse like hashed features
+    p = tmp_path / "d.csv"
+    write_csv(str(p), x, y)
+    header = p.read_text().splitlines()[0]
+    assert header.endswith(",Score")  # reference label column name
+    xx, yy = run_mod.load_test_csv(str(p), 32)
+    np.testing.assert_allclose(xx, x, atol=1e-4)
+    np.testing.assert_array_equal(yy, y)
+
+
+def test_load_test_csv_width_check(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("a,b,c\n1,2,3\n")
+    with pytest.raises(SystemExit, match="expected 5"):
+        run_mod.load_test_csv(str(p), 4)
+
+
+def test_multi_step_equals_repeated_single_step():
+    cfg = ModelConfig(num_features=8, num_classes=2, local_learning_rate=0.3)
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    nw, cap = 4, 16
+    x = jnp.asarray(rng.normal(size=(nw, cap, 8)).astype(np.float32))
+    y = jnp.asarray(rng.integers(1, 3, size=(nw, cap)).astype(np.int32))
+    mask = jnp.ones((nw, cap))
+    theta0 = jnp.zeros(cfg.num_params)
+
+    multi = bsp.make_bsp_multi_step(cfg, nw, 0.25, rounds=5)
+    t_multi, losses = multi(theta0, x, y, mask)
+    assert losses.shape == (5,)
+
+    single = bsp.make_bsp_step(cfg, nw, 0.25)
+    t = theta0
+    for _ in range(5):
+        t, _ = single(t, x, y, mask)
+    np.testing.assert_allclose(np.asarray(t_multi), np.asarray(t), atol=1e-5)
+
+
+def test_multi_step_mesh_matches_vmap():
+    cfg = ModelConfig(num_features=8, num_classes=2, local_learning_rate=0.3)
+    import jax.numpy as jnp
+    rng = np.random.default_rng(1)
+    nw, cap = 8, 16
+    x = rng.normal(size=(nw, cap, 8)).astype(np.float32)
+    y = rng.integers(1, 3, size=(nw, cap)).astype(np.int32)
+    mask = np.ones((nw, cap), np.float32)
+    theta0 = jnp.zeros(cfg.num_params)
+
+    m = mesh_mod.worker_mesh()
+    multi_mesh = bsp.make_bsp_multi_step(cfg, nw, 1 / nw, rounds=4, mesh=m)
+    xs, ys, ms = bsp.shard_worker_batches(m, x, y, mask)
+    t_mesh, _ = multi_mesh(theta0, xs, ys, ms)
+
+    multi_vmap = bsp.make_bsp_multi_step(cfg, nw, 1 / nw, rounds=4)
+    t_vmap, _ = multi_vmap(theta0, x, y, mask)
+    np.testing.assert_allclose(np.asarray(t_mesh), np.asarray(t_vmap),
+                               atol=2e-5)
+
+
+def test_graft_entry_dryrun():
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    import jax
+    loss = jax.jit(fn)(*args)
+    assert np.isfinite(float(loss))
+    g.dryrun_multichip(4)
