@@ -78,14 +78,13 @@ def build_parser(include_server_flags: bool = True,
 def load_test_csv(path: str, num_features: int):
     """Test set: dense CSV with header, label in the last column
     (LogisticRegressionTaskSpark.java:77-92)."""
-    data = np.loadtxt(path, delimiter=",", skiprows=1)
-    if data.ndim == 1:
-        data = data[None, :]
-    if data.shape[1] != num_features + 1:
+    from kafka_ps_tpu.data.stream import load_csv_dataset
+    x, y = load_csv_dataset(path)
+    if x.shape[1] != num_features:
         raise SystemExit(
-            f"test CSV has {data.shape[1]} columns, expected "
+            f"test CSV has {x.shape[1] + 1} columns, expected "
             f"{num_features + 1} (features + label)")
-    return data[:, :-1].astype(np.float32), data[:, -1].astype(np.int32)
+    return x, y
 
 
 def make_app_from_args(args, resuming: bool = False):

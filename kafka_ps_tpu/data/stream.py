@@ -83,3 +83,16 @@ class CsvStreamProducer:
                              name="csv-stream-producer")
         t.start()
         return t
+
+
+def load_csv_dataset(csv_path: str, has_header: bool = True
+                     ) -> tuple["np.ndarray", "np.ndarray"]:
+    """Whole CSV as dense (x, y) — label in the last column, the
+    reference's file layout (CsvProducer.java:52-58, header column
+    `Score`, LogisticRegressionTaskSpark.java:86-92)."""
+    import numpy as np
+    data = np.loadtxt(csv_path, delimiter=",",
+                      skiprows=1 if has_header else 0)
+    if data.ndim == 1:
+        data = data[None, :]
+    return data[:, :-1].astype(np.float32), data[:, -1].astype(np.int32)

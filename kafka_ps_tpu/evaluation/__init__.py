@@ -1,0 +1,17 @@
+"""Offline evaluation — the reference's evaluation/ notebooks as modules.
+
+Ports (behavior, not code) of:
+  * evaluation/plot-generation.ipynb        -> plots.plot_run
+  * evaluation/evaluation-multipleDatasetsAtOnce.ipynb -> plots.plot_comparison
+  * evaluation/python-ground-truth-algorithm.ipynb     -> ground_truth
+All read the CSV log schema emitted by utils/csvlog.py (identical to the
+reference's stdout-redirect schema, ServerAppRunner.java:81,
+WorkerAppRunner.java:80).
+"""
+
+from kafka_ps_tpu.evaluation.logs import (  # noqa: F401
+    RunSummary,
+    load_server_log,
+    load_worker_log,
+    summarize_run,
+)

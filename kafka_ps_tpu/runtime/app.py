@@ -179,11 +179,12 @@ class StreamingPSApp:
             mask = np.stack([s[2] for s in slabs])
             if mesh is not None:
                 x, y, mask = bsp.shard_worker_batches(mesh, x, y, mask)
-            theta, _ = step(theta, x, y, mask)
+            theta, mean_loss = step(theta, x, y, mask)
             clock += 1
             self.server.iterations += self.cfg.num_workers
             self.server.theta = np.asarray(theta)
-            for w in range(self.cfg.num_workers):
+            for w, worker in enumerate(self.workers):
+                worker.iterations += 1
                 self.server.tracker.tracker[w].vector_clock = clock
                 self.server.tracker.tracker[w].weights_message_sent = True
             self.server.maybe_checkpoint()
@@ -193,9 +194,20 @@ class StreamingPSApp:
                                          self.server.test_y,
                                          cfg=self.cfg.model)
                 self.server.last_metrics = m
+                now = int(time.time() * 1000)
                 self.server.log(
-                    f"{int(time.time() * 1000)};-1;{clock};{float(m.loss)};"
+                    f"{now};-1;{clock};{float(m.loss)};"
                     f"{float(m.f1)};{float(m.accuracy)}")
+                # Worker log lines, same schema/cadence as the per-node
+                # path (WorkerTrainingProcessor.java:85-92).  The fused
+                # step returns the mean local training loss; test metrics
+                # are identical across workers under BSP (replicated
+                # weights), so each line carries the shared values.
+                for w, worker in enumerate(self.workers):
+                    worker.log(
+                        f"{now};{w};{clock};{float(mean_loss)};"
+                        f"{float(m.f1)};{float(m.accuracy)};"
+                        f"{self.buffers[w].num_tuples_seen}")
 
     def stop(self) -> None:
         self._stop.set()

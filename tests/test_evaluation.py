@@ -1,0 +1,143 @@
+"""Offline evaluation subsystem — log parsing, summaries, plots, ground
+truth (ports of the reference's evaluation/ notebooks, SURVEY §3.4)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.data.synth import generate
+from kafka_ps_tpu.evaluation import ground_truth, logs
+from kafka_ps_tpu.utils.config import ModelConfig
+from kafka_ps_tpu.utils.csvlog import SERVER_HEADER, WORKER_HEADER
+
+
+def _write_server_log(path, n=20, t0=1000000, dt_ms=500):
+    with open(path, "w") as f:
+        f.write(SERVER_HEADER + "\n")
+        for i in range(n):
+            f1 = min(0.45, 0.05 * i)
+            acc = min(0.46, 0.05 * i + 0.01)
+            loss = max(0.2, 1.6 - 0.1 * i)
+            f.write(f"{t0 + i * dt_ms};-1;{i};{loss};{f1};{acc}\n")
+
+
+def _write_worker_log(path, n=20, workers=4, t0=1000000, dt_ms=500):
+    with open(path, "w") as f:
+        f.write(WORKER_HEADER + "\n")
+        for i in range(n):
+            for w in range(workers):
+                f.write(f"{t0 + i * dt_ms + w};{w};{i};0.5;0.3;0.3;"
+                        f"{128 + 4 * i}\n")
+
+
+def test_summarize_run_derived_columns(tmp_path):
+    sp = tmp_path / "logs-server.csv"
+    wp = tmp_path / "logs-worker.csv"
+    _write_server_log(sp, n=20, dt_ms=500)
+    _write_worker_log(wp, n=20)
+    s = logs.summarize_run(logs.load_server_log(sp),
+                           logs.load_worker_log(wp))
+    assert s.iterations == 19
+    assert s.duration_s == pytest.approx(9.5)
+    assert s.iters_per_sec == pytest.approx(2.0)
+    assert s.best_f1 == pytest.approx(0.45)
+    # f1 >= 0.40 first hit at i=8 -> 4.0 s
+    assert s.secs_to_f1[0.40] == pytest.approx(4.0)
+    assert s.worker_updates_per_sec is not None
+
+
+def test_summarize_unreached_target_is_none(tmp_path):
+    sp = tmp_path / "s.csv"
+    _write_server_log(sp, n=3)
+    s = logs.summarize_run(logs.load_server_log(sp),
+                           f1_targets=(0.99,))
+    assert s.secs_to_f1[0.99] is None
+
+
+def test_compare_runs_table(tmp_path):
+    a, b = tmp_path / "a.csv", tmp_path / "b.csv"
+    _write_server_log(a, n=10)
+    _write_server_log(b, n=20)
+    table = logs.compare_runs({"fast": str(a), "slow": str(b)})
+    assert list(table["run"]) == ["fast", "slow"]
+    assert table.loc[1, "iterations"] == 19
+
+
+def test_worker_clock_spread(tmp_path):
+    wp = tmp_path / "w.csv"
+    _write_worker_log(wp, n=10)
+    spread = logs.worker_clock_spread(logs.load_worker_log(wp))
+    # synchronized workers: zero cross-worker staleness
+    assert spread["spread"].max() == 0
+
+
+def test_worker_clock_spread_single_fast_worker(tmp_path):
+    # one worker logging 8 clocks within one second is progression, not
+    # staleness — spread must be 0
+    wp = tmp_path / "w.csv"
+    _write_worker_log(wp, n=8, workers=1, dt_ms=50)
+    spread = logs.worker_clock_spread(logs.load_worker_log(wp))
+    assert spread["spread"].max() == 0
+
+
+def test_worker_clock_spread_straggler(tmp_path):
+    # worker 1 stuck at clock 0 while worker 0 advances -> spread grows
+    wp = tmp_path / "w.csv"
+    with open(wp, "w") as f:
+        f.write(WORKER_HEADER + "\n")
+        for i in range(5):
+            f.write(f"{1000000 + i * 1000};0;{i};0.5;0.3;0.3;128\n")
+            f.write(f"{1000000 + i * 1000};1;0;0.5;0.3;0.3;128\n")
+    spread = logs.worker_clock_spread(logs.load_worker_log(wp))
+    assert spread["spread"].iloc[-1] == 4
+
+
+def test_summarize_zero_duration_gives_none_rate(tmp_path):
+    sp = tmp_path / "s.csv"
+    with open(sp, "w") as f:
+        f.write(SERVER_HEADER + "\n")
+        f.write("1000000;-1;0;1.6;0.1;0.1\n")
+    s = logs.summarize_run(logs.load_server_log(sp))
+    assert s.iters_per_sec is None
+    json.dumps(s.row())   # must stay valid JSON
+
+
+def test_plots_write_files(tmp_path):
+    sp, wp = tmp_path / "s.csv", tmp_path / "w.csv"
+    _write_server_log(sp)
+    _write_worker_log(wp)
+    from kafka_ps_tpu.evaluation import plots
+    p1 = plots.plot_run(str(sp), str(wp), str(tmp_path / "run.png"))
+    p2 = plots.plot_comparison({"a": str(sp)}, str(tmp_path / "cmp.png"))
+    p3 = plots.plot_clock_spread(str(wp), str(tmp_path / "spread.png"))
+    for p in (p1, p2, p3):
+        assert os.path.getsize(p) > 0
+
+
+def test_ground_truth_learns_synthetic():
+    cfg = ModelConfig(num_features=32, num_classes=5)
+    x, y = generate(1200, cfg.num_features, cfg.num_classes,
+                    noise=0.5, sparsity=0.3, seed=3)
+    gt = ground_truth.compute(x[:1000], y[:1000], x[1000:], y[1000:],
+                              cfg, steps=200, learning_rate=0.5)
+    # separable synthetic data: the offline oracle must be strong
+    assert gt.f1 > 0.8
+    assert gt.accuracy > 0.8
+    assert "precision" in gt.report
+
+
+def test_evaluation_cli_summarize(tmp_path):
+    sp = tmp_path / "s.csv"
+    _write_server_log(sp)
+    out = subprocess.run(
+        [sys.executable, "-m", "kafka_ps_tpu.evaluation", "summarize",
+         "--server", str(sp)],
+        capture_output=True, text=True, check=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    data = json.loads(out.stdout)
+    assert data["iterations"] == 19
