@@ -65,6 +65,10 @@ def build_parser(include_server_flags: bool = True,
     p.add_argument("--fused", action="store_true",
                    help="sequential model as fused shard_map steps "
                         "(TPU fast path)")
+    p.add_argument("--pallas", action="store_true",
+                   help="use the Pallas fused local-update kernel for "
+                        "worker iterations (ops/fused_update.py; "
+                        "auto-falls-back off-TPU)")
     p.add_argument("--mode", choices=["threaded", "serial"],
                    default="threaded")
     p.add_argument("--checkpoint", default=None,
@@ -105,6 +109,7 @@ def make_app_from_args(args, resuming: bool = False):
                             max_size=args.max_buffer_size,
                             coefficient=args.buffer_size_coefficient),
         stream=StreamConfig(time_per_event_ms=args.producer_time_per_event),
+        use_pallas=args.pallas,
     )
     test_x, test_y = load_test_csv(args.test_data_file_path,
                                    args.num_features)
@@ -147,6 +152,11 @@ def run_with_args(args) -> int:
 
     max_iters = args.max_iterations or sys.maxsize
     try:
+        if args.fused and args.pallas:
+            raise SystemExit(
+                "--pallas applies to the per-node worker path only; the "
+                "--fused BSP path runs its own shard_map program "
+                "(parallel/bsp.py) — drop one of the two flags")
         if args.fused:
             app.run_fused_bsp(max_server_iterations=max_iters)
         elif args.mode == "serial":

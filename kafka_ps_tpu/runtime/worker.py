@@ -60,7 +60,12 @@ class WorkerNode:
             raise RuntimeError(
                 f"There is no data in the buffer of worker {self.worker_id}")
 
-        delta, loss = logreg.local_update(
+        if self.cfg.use_pallas:
+            from kafka_ps_tpu.ops import fused_update
+            update_fn = fused_update.local_update
+        else:
+            update_fn = logreg.local_update
+        delta, loss = update_fn(
             jnp.asarray(self.theta), jnp.asarray(x), jnp.asarray(y),
             jnp.asarray(mask), cfg=self.cfg.model)
         delta = np.asarray(delta)

@@ -77,6 +77,10 @@ class PSConfig:
     learning_rate: float | None = None
     eval_every: int = 1   # server evaluates test metrics every iteration
     seed: int = 0
+    # Use the Pallas fused local-update kernel (ops/fused_update.py) for
+    # worker iterations; falls back to the XLA path off-TPU or when the
+    # buffer exceeds the VMEM budget.
+    use_pallas: bool = False
 
     @property
     def server_lr(self) -> float:

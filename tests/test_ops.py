@@ -1,0 +1,76 @@
+"""Pallas fused local-update kernel vs the XLA reference path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.data.synth import generate
+from kafka_ps_tpu.models import logreg
+from kafka_ps_tpu.ops import fused_update
+from kafka_ps_tpu.utils.config import ModelConfig
+
+CFG = ModelConfig(num_features=64, num_classes=5)
+
+
+def _batch(n=48, seed=0, cfg=CFG):
+    x, y = generate(n, cfg.num_features, cfg.num_classes, noise=1.0,
+                    sparsity=0.5, seed=seed)
+    mask = (np.arange(n) < n - 5).astype(np.float32)   # some masked rows
+    return jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask)
+
+
+def _theta(cfg=CFG, seed=1):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(scale=0.1, size=(cfg.num_params,)),
+                       dtype=jnp.float32)
+
+
+def test_kernel_matches_xla_path():
+    x, y, mask = _batch()
+    theta = _theta()
+    d_ref, loss_ref = logreg.local_update(theta, x, y, mask, cfg=CFG)
+    d_pl, loss_pl = fused_update.local_update(theta, x, y, mask, cfg=CFG,
+                                              interpret=True)
+    np.testing.assert_allclose(np.asarray(d_pl), np.asarray(d_ref),
+                               rtol=2e-4, atol=2e-5)
+    assert float(loss_pl) == pytest.approx(float(loss_ref), rel=2e-4)
+
+
+def test_kernel_batch_padding():
+    # batch not a multiple of 8 exercises the pad-with-zero-mask path
+    x, y, mask = _batch(n=37)
+    theta = _theta()
+    d_ref, _ = logreg.local_update(theta, x, y, mask, cfg=CFG)
+    d_pl, _ = fused_update.local_update(theta, x, y, mask, cfg=CFG,
+                                        interpret=True)
+    np.testing.assert_allclose(np.asarray(d_pl), np.asarray(d_ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_kernel_all_masked_rows_no_nan():
+    x, y, _ = _batch(n=16)
+    mask = jnp.zeros((16,), jnp.float32)
+    d_pl, loss = fused_update.local_update(_theta(), x, y, mask, cfg=CFG,
+                                           interpret=True)
+    assert np.isfinite(np.asarray(d_pl)).all()
+    assert np.isfinite(float(loss))
+
+
+def test_oversize_batch_falls_back():
+    cfg = ModelConfig(num_features=64, num_classes=5)
+    assert not fused_update.fits_in_vmem(fused_update._VMEM_ELEM_BUDGET, 2)
+    # fallback executes the XLA path (no error on CPU, no interpret)
+    x, y, mask = _batch(n=24)
+    d, loss = fused_update.local_update(_theta(), x, y, mask, cfg=cfg)
+    assert d.shape == (cfg.num_params,)
+    assert np.isfinite(float(loss))
+
+
+def test_fallback_refusal_when_disallowed():
+    if jax.default_backend() == "tpu":
+        pytest.skip("fallback only triggers off-TPU")
+    x, y, mask = _batch(n=24)
+    with pytest.raises(ValueError, match="pallas local_update unavailable"):
+        fused_update.local_update(_theta(), x, y, mask, cfg=CFG,
+                                  allow_fallback=False)
