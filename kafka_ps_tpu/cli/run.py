@@ -128,6 +128,11 @@ def main(argv=None) -> int:
 
 
 def run_with_args(args) -> int:
+    if args.fused and args.pallas:
+        raise SystemExit(
+            "--pallas applies to the per-node worker path only; the "
+            "--fused BSP path runs its own shard_map program "
+            "(parallel/bsp.py) — drop one of the two flags")
     if args.verbose:
         print("\nUsed parameter:")
         for k, v in sorted(vars(args).items()):
@@ -152,11 +157,6 @@ def run_with_args(args) -> int:
 
     max_iters = args.max_iterations or sys.maxsize
     try:
-        if args.fused and args.pallas:
-            raise SystemExit(
-                "--pallas applies to the per-node worker path only; the "
-                "--fused BSP path runs its own shard_map program "
-                "(parallel/bsp.py) — drop one of the two flags")
         if args.fused:
             app.run_fused_bsp(max_server_iterations=max_iters)
         elif args.mode == "serial":

@@ -13,14 +13,15 @@ steps, models/logreg.local_update) with all operands resident in VMEM:
         b     -= lr * g.sum(0)
     loss = masked-CE(x, y; W, b)
 
-No HBM round-trips between the k steps — the weights live in VMEM
-scratch across iterations.  The class axis is padded to 128 lanes
-(min f32 tile is 8×128); padded classes are −1e30-masked out of the
-softmax so their rows never receive gradient.
+No HBM round-trips between the k steps — the weights are the fori_loop
+carry, resident on-chip across iterations.  The class axis is padded to
+128 lanes (min f32 tile is 8×128); padded classes are −1e30-masked out
+of the softmax so their rows never receive gradient.
 
-Workloads bigger than VMEM (B·F beyond ~2M f32 elements) fall back to
-the XLA path in models/logreg — at the reference's shapes
-(B≤1024, F=1024, C=5) the whole problem fits on-chip.
+Workloads whose working set exceeds the VMEM budget (see fits_in_vmem:
+x + weight-shaped tensors + activations) fall back to the XLA path in
+models/logreg — at the reference's shapes (B≤1024, F=1024, C=5) the
+whole problem fits on-chip.
 """
 
 from __future__ import annotations
@@ -36,11 +37,11 @@ from kafka_ps_tpu.models import logreg
 from kafka_ps_tpu.utils.config import ModelConfig
 
 LANES = 128          # last-dim tile width; class axis padded up to this
-_VMEM_ELEM_BUDGET = 2_621_440   # ~10 MB of f32 for x alone
+_VMEM_BYTE_BUDGET = 12 * 1024 * 1024   # leave headroom below ~16 MB/core
 
 
 def _kernel(x_ref, y_ref, mask_ref, w0_ref, b0_ref,
-            dw_ref, db_ref, loss_ref, w_scr, b_scr,
+            dw_ref, db_ref, loss_ref,
             *, k: int, lr: float, num_rows: int):
     x = x_ref[:]                       # [B, F]
     y = y_ref[:]                       # [B, 1] int32
@@ -52,9 +53,6 @@ def _kernel(x_ref, y_ref, mask_ref, w0_ref, b0_ref,
     valid = (class_ids < num_rows).astype(jnp.float32)
     neg_inf_pad = (1.0 - valid) * (-1e30)                  # kill padded classes
     denom = jnp.maximum(jnp.sum(mask), 1.0)
-
-    w_scr[:] = w0_ref[:]               # [C8, F]
-    b_scr[:] = b0_ref[:]               # [1, C8]
 
     def logp_of(w, b):
         logits = jax.lax.dot_general(
@@ -71,9 +69,7 @@ def _kernel(x_ref, y_ref, mask_ref, w0_ref, b0_ref,
             preferred_element_type=jnp.float32)            # [C8, F]
         return w - lr * gw, b - lr * jnp.sum(g, axis=0, keepdims=True)
 
-    w, b = jax.lax.fori_loop(0, k, body, (w_scr[:], b_scr[:]))
-    w_scr[:] = w
-    b_scr[:] = b
+    w, b = jax.lax.fori_loop(0, k, body, (w0_ref[:], b0_ref[:]))
 
     logp = logp_of(w, b)
     nll = -jnp.sum(logp * onehot, axis=-1, keepdims=True)  # [B, 1]
@@ -83,7 +79,13 @@ def _kernel(x_ref, y_ref, mask_ref, w0_ref, b0_ref,
 
 
 def fits_in_vmem(batch: int, num_features: int) -> bool:
-    return batch * num_features <= _VMEM_ELEM_BUDGET
+    """Whole-problem VMEM residency estimate: x, the class-padded weight
+    tensors (w0/dw + loop carry + gradient), and the [B, LANES]
+    activations, all f32."""
+    weight_like = 4 * LANES * num_features      # w0, dw, carry w, grad w
+    act_like = 3 * batch * LANES                # onehot, logp, g
+    total = batch * num_features + weight_like + act_like
+    return total * 4 <= _VMEM_BYTE_BUDGET
 
 
 @functools.partial(jax.jit,
@@ -135,10 +137,6 @@ def local_update(theta: jax.Array, x: jax.Array, y: jax.Array,
         out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
                    pl.BlockSpec(memory_space=pltpu.VMEM),
                    pl.BlockSpec(memory_space=pltpu.SMEM)),
-        scratch_shapes=[
-            pltpu.VMEM((LANES, num_features), jnp.float32),
-            pltpu.VMEM((1, LANES), jnp.float32),
-        ],
         interpret=interpret,
     )(x.astype(jnp.float32),
       y.astype(jnp.int32).reshape(-1, 1),

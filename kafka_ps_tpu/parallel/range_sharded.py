@@ -1,0 +1,134 @@
+"""Range-sharded parameter server — the reference's latent KeyRange axis
+(messages/KeyRange.java, carried by every message but always full-range,
+ServerProcessor.java:198-208) made real, the TPU way.
+
+Classic parameter-server deployments shard the key space across server
+nodes; the reference kept that hook but ran a single server
+(README.md:115-119).  Here the parameter vector is sharded over a
+`params` mesh axis while workers stay data-parallel over a `workers`
+axis (a 2-D mesh, parallel/mesh.worker_param_mesh):
+
+    theta shard [P/ps] per device column
+      └─ all_gather over params axis  → full theta (the "weights pull")
+      └─ k-step local update on this device's buffer slab — logical
+         workers are sharded over BOTH mesh axes, so every device
+         computes (no redundant work on the param columns)
+      └─ delta: psum over the full mesh, then each device keeps its own
+         key range (axis_index slice — the "gradient push" lands
+         pre-sharded, like a classic PS server group)
+      └─ theta_shard += server_lr * delta_shard
+
+The collectives ride ICI; per-device parameter memory drops by the
+param-shard factor (the scaling story for models far bigger than LR —
+this is the ZeRO/weight-sharded-DP pattern expressed in shard_map).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kafka_ps_tpu.models import logreg
+from kafka_ps_tpu.parallel.mesh import PARAM_AXIS, WORKER_AXIS
+from kafka_ps_tpu.utils.config import ModelConfig
+
+
+def padded_num_params(cfg: ModelConfig, num_param_shards: int) -> int:
+    """theta length padded so every param shard is equal-size (static
+    shapes; the pad keys are dead weight ignored by unflatten)."""
+    p = cfg.num_params
+    return p + (-p) % num_param_shards
+
+
+def pad_theta(theta, cfg: ModelConfig, num_param_shards: int):
+    return jnp.pad(jnp.asarray(theta),
+                   (0, padded_num_params(cfg, num_param_shards)
+                    - cfg.num_params))
+
+
+def shard_theta(mesh: Mesh, theta, cfg: ModelConfig):
+    """Place the (padded) parameter vector range-sharded over the params
+    axis, replicated over the workers axis."""
+    num_param_shards = mesh.shape[PARAM_AXIS]
+    return jax.device_put(pad_theta(theta, cfg, num_param_shards),
+                          NamedSharding(mesh, P(PARAM_AXIS)))
+
+
+def shard_worker_batches(mesh: Mesh, x, y, mask):
+    """Worker slabs sharded over BOTH mesh axes — every device hosts
+    num_workers / (worker_shards * param_shards) logical workers."""
+    return tuple(
+        jax.device_put(a, NamedSharding(mesh, P((WORKER_AXIS, PARAM_AXIS))))
+        for a in (x, y, mask))
+
+
+# step(theta_padded, x, y, mask) -> (theta_padded', mean_loss)
+RangeShardedStep = Callable[..., tuple[jax.Array, jax.Array]]
+
+
+def make_range_sharded_step(cfg: ModelConfig, num_workers: int,
+                            server_lr: float, mesh: Mesh,
+                            rounds: int = 1) -> RangeShardedStep:
+    """Fused BSP step(s) with range-sharded parameters on a 2-D
+    (workers × params) mesh.  `rounds > 1` scans whole iterations into
+    one device program, like bsp.make_bsp_multi_step."""
+    if WORKER_AXIS not in mesh.shape or PARAM_AXIS not in mesh.shape:
+        raise ValueError(
+            f"mesh must have axes ({WORKER_AXIS!r}, {PARAM_AXIS!r}), "
+            f"got {dict(mesh.shape)}")
+    num_devices = mesh.shape[WORKER_AXIS] * mesh.shape[PARAM_AXIS]
+    if num_workers % num_devices != 0:
+        raise ValueError(
+            f"num_workers {num_workers} must be a multiple of the mesh "
+            f"size {num_devices} (workers are sharded over both axes)")
+    param_shards = mesh.shape[PARAM_AXIS]
+    n_pad = padded_num_params(cfg, param_shards)
+    shard_len = n_pad // param_shards
+
+    def local_update_padded(theta_full, xx, yy, mm):
+        delta, loss = logreg.local_update(theta_full[:cfg.num_params],
+                                          xx, yy, mm, cfg=cfg)
+        return jnp.pad(delta, (0, n_pad - cfg.num_params)), loss
+
+    def round_body(theta_shard, x, y, mask):
+        # weights pull: reassemble the full replica from the server shards
+        theta_full = jax.lax.all_gather(theta_shard, PARAM_AXIS, axis=0,
+                                        tiled=True)
+        theta_full = jax.lax.pvary(theta_full, WORKER_AXIS)
+        deltas, losses = jax.vmap(
+            lambda xx, yy, mm: local_update_padded(theta_full, xx, yy, mm)
+        )(x, y, mask)
+        # gradient push: global sum, then each server shard keeps only
+        # its own key range
+        delta = jax.lax.psum(deltas.sum(0), (WORKER_AXIS, PARAM_AXIS))
+        delta_shard = jax.lax.dynamic_slice(
+            delta, (jax.lax.axis_index(PARAM_AXIS) * shard_len,),
+            (shard_len,))
+        loss_sum = jax.lax.psum(losses.sum(), (WORKER_AXIS, PARAM_AXIS))
+        return (theta_shard + server_lr * delta_shard,
+                loss_sum / num_workers)
+
+    def shard_body(theta_shard, x, y, mask):
+        def body(t, _):
+            return round_body(t, x, y, mask)
+        theta, losses = jax.lax.scan(body, theta_shard, None, length=rounds)
+        # scalar loss for the single-round step (API parity with
+        # bsp.make_bsp_step); per-round losses when scanning
+        return theta, (losses[0] if rounds == 1 else losses)
+
+    data_spec = P((WORKER_AXIS, PARAM_AXIS))
+    sharded = jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(P(PARAM_AXIS), data_spec, data_spec, data_spec),
+        out_specs=(P(PARAM_AXIS), P()))
+    return jax.jit(sharded)
+
+
+def unshard_theta(theta_padded, cfg: ModelConfig) -> np.ndarray:
+    """Back to the host-side flat layout (drops the shard padding)."""
+    return np.asarray(theta_padded)[:cfg.num_params]

@@ -58,12 +58,24 @@ def test_kernel_all_masked_rows_no_nan():
 
 
 def test_oversize_batch_falls_back():
-    cfg = ModelConfig(num_features=64, num_classes=5)
-    assert not fused_update.fits_in_vmem(fused_update._VMEM_ELEM_BUDGET, 2)
-    # fallback executes the XLA path (no error on CPU, no interpret)
-    x, y, mask = _batch(n=24)
-    d, loss = fused_update.local_update(_theta(), x, y, mask, cfg=cfg)
-    assert d.shape == (cfg.num_params,)
+    # features so wide the weight tensors alone blow the VMEM budget
+    assert not fused_update.fits_in_vmem(16, 150_000)
+    # an oversize problem routed through local_update takes the XLA
+    # fallback even with interpret=True (which would otherwise force
+    # the kernel) — allow_fallback=False proves which path ran
+    cfg = ModelConfig(num_features=512, num_classes=5)
+    big = fused_update._VMEM_BYTE_BUDGET // (4 * cfg.num_features) + 8
+    big += (-big) % 8
+    x, y, mask = _batch(n=big, cfg=cfg)
+    assert not fused_update.fits_in_vmem(big, cfg.num_features)
+    with pytest.raises(ValueError, match="pallas local_update unavailable"):
+        fused_update.local_update(_theta(cfg), x, y, mask, cfg=cfg,
+                                  interpret=True, allow_fallback=False)
+    d, loss = fused_update.local_update(_theta(cfg), x, y, mask, cfg=cfg,
+                                        interpret=True)
+    d_ref, _ = logreg.local_update(_theta(cfg), x, y, mask, cfg=cfg)
+    np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref),
+                               rtol=1e-6, atol=1e-7)
     assert np.isfinite(float(loss))
 
 

@@ -1,0 +1,94 @@
+"""Range-sharded parameter server (the KeyRange axis) on the virtual
+8-device CPU mesh: must match the unsharded BSP step exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.data.synth import generate
+from kafka_ps_tpu.parallel import bsp, mesh as mesh_mod, range_sharded
+from kafka_ps_tpu.utils.config import ModelConfig
+
+CFG = ModelConfig(num_features=32, num_classes=5)   # 203 params (odd: pads)
+
+
+def _slabs(num_workers, cap=16, cfg=CFG, seed=0):
+    x, y = generate(num_workers * cap, cfg.num_features, cfg.num_classes,
+                    noise=1.0, sparsity=0.5, seed=seed)
+    x = x.reshape(num_workers, cap, cfg.num_features)
+    y = y.reshape(num_workers, cap)
+    mask = np.ones((num_workers, cap), np.float32)
+    mask[:, -3:] = 0.0          # some masked slots
+    return x, y, mask
+
+
+def _mesh_or_skip(w, p):
+    if len(jax.devices()) < w * p:
+        pytest.skip(f"needs {w * p} devices")
+    return mesh_mod.worker_param_mesh(w, p)
+
+
+@pytest.mark.parametrize("wshards,pshards", [(4, 2), (2, 4), (1, 8)])
+def test_matches_unsharded_bsp(wshards, pshards):
+    mesh = _mesh_or_skip(wshards, pshards)
+    num_workers = 8
+    server_lr = 1.0 / num_workers
+    x, y, mask = _slabs(num_workers)
+
+    ref_step = bsp.make_bsp_step(CFG, num_workers, server_lr)
+    theta0 = jnp.zeros((CFG.num_params,), jnp.float32)
+    ref_theta, ref_loss = ref_step(theta0, jnp.asarray(x), jnp.asarray(y),
+                                   jnp.asarray(mask))
+
+    step = range_sharded.make_range_sharded_step(CFG, num_workers,
+                                                 server_lr, mesh)
+    theta_sh = range_sharded.shard_theta(mesh, theta0, CFG)
+    xs, ys, ms = range_sharded.shard_worker_batches(mesh, x, y, mask)
+    out_theta, loss = step(theta_sh, xs, ys, ms)
+    out = range_sharded.unshard_theta(out_theta, CFG)
+
+    np.testing.assert_allclose(out, np.asarray(ref_theta),
+                               rtol=1e-5, atol=1e-6)
+    assert float(loss) == pytest.approx(float(ref_loss), rel=1e-5)
+
+
+def test_multi_round_scan_matches_sequential_steps():
+    mesh = _mesh_or_skip(2, 2)
+    num_workers = 4
+    server_lr = 0.25
+    x, y, mask = _slabs(num_workers)
+    theta0 = jnp.zeros((CFG.num_params,), jnp.float32)
+
+    ref_step = bsp.make_bsp_step(CFG, num_workers, server_lr)
+    ref_theta = theta0
+    for _ in range(3):
+        ref_theta, _ = ref_step(ref_theta, jnp.asarray(x), jnp.asarray(y),
+                                jnp.asarray(mask))
+
+    step3 = range_sharded.make_range_sharded_step(CFG, num_workers,
+                                                  server_lr, mesh, rounds=3)
+    theta_sh = range_sharded.shard_theta(mesh, theta0, CFG)
+    xs, ys, ms = range_sharded.shard_worker_batches(mesh, x, y, mask)
+    out_theta, losses = step3(theta_sh, xs, ys, ms)
+    assert losses.shape == (3,)
+    np.testing.assert_allclose(range_sharded.unshard_theta(out_theta, CFG),
+                               np.asarray(ref_theta), rtol=1e-5, atol=1e-6)
+
+
+def test_padding_roundtrip():
+    assert range_sharded.padded_num_params(CFG, 4) % 4 == 0
+    theta = jnp.arange(CFG.num_params, dtype=jnp.float32)
+    padded = range_sharded.pad_theta(theta, CFG, 4)
+    assert padded.shape[0] == range_sharded.padded_num_params(CFG, 4)
+    np.testing.assert_array_equal(
+        range_sharded.unshard_theta(padded, CFG), np.asarray(theta))
+
+
+def test_rejects_bad_mesh_and_worker_counts():
+    mesh = _mesh_or_skip(2, 2)
+    with pytest.raises(ValueError, match="multiple of the mesh"):
+        range_sharded.make_range_sharded_step(CFG, 3, 0.25, mesh)
+    bad = mesh_mod.worker_mesh(num_devices=2)   # 1-D mesh: no params axis
+    with pytest.raises(ValueError, match="axes"):
+        range_sharded.make_range_sharded_step(CFG, 4, 0.25, bad)
