@@ -65,6 +65,13 @@ def build_parser(include_server_flags: bool = True,
     p.add_argument("--fused", action="store_true",
                    help="sequential model as fused shard_map steps "
                         "(TPU fast path)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="write a Chrome trace-event JSON (spans + message "
+                        "counters) on exit and print span stats — replaces "
+                        "the reference's Confluent monitoring interceptors")
+    p.add_argument("--device_trace", default=None, metavar="LOGDIR",
+                   help="capture a jax.profiler device trace (TensorBoard "
+                        "logdir) for the whole run")
     p.add_argument("--pallas", action="store_true",
                    help="use the Pallas fused local-update kernel for "
                         "worker iterations (ops/fused_update.py; "
@@ -117,8 +124,13 @@ def make_app_from_args(args, resuming: bool = False):
                             SERVER_HEADER, append=resuming)
     worker_log = CsvLogSink("./logs-worker.csv" if args.logging else None,
                             WORKER_HEADER, append=resuming)
+    tracer = None
+    if getattr(args, "trace", None):
+        from kafka_ps_tpu.utils.trace import Tracer
+        tracer = Tracer()
     app = StreamingPSApp(cfg, test_x=test_x, test_y=test_y,
-                         server_log=server_log, worker_log=worker_log)
+                         server_log=server_log, worker_log=worker_log,
+                         tracer=tracer)
     return app, (server_log, worker_log)
 
 
@@ -156,14 +168,16 @@ def run_with_args(args) -> int:
     app.wait_for_prefill(min_per_worker=1, timeout=120.0)
 
     max_iters = args.max_iterations or sys.maxsize
+    from kafka_ps_tpu.utils.trace import device_trace
     try:
-        if args.fused:
-            app.run_fused_bsp(max_server_iterations=max_iters)
-        elif args.mode == "serial":
-            app.run_serial(max_server_iterations=max_iters,
-                           pump=lambda: None)
-        else:
-            app.run_threaded(max_server_iterations=max_iters)
+        with device_trace(args.device_trace):
+            if args.fused:
+                app.run_fused_bsp(max_server_iterations=max_iters)
+            elif args.mode == "serial":
+                app.run_serial(max_server_iterations=max_iters,
+                               pump=lambda: None)
+            else:
+                app.run_threaded(max_server_iterations=max_iters)
     except KeyboardInterrupt:
         print("interrupted — shutting down", file=sys.stderr)
         app.stop()
@@ -173,6 +187,12 @@ def run_with_args(args) -> int:
             ckpt.save(args.checkpoint, app.server)
         for log in logs:
             log.close()
+        if args.trace:
+            import json as _json
+            print(app.tracer.dump(args.trace), file=sys.stderr)
+            print(_json.dumps({"spans": app.tracer.span_stats(),
+                               "counters": app.tracer.counters()},
+                              indent=2), file=sys.stderr)
     return 0
 
 

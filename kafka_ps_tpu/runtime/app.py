@@ -31,6 +31,7 @@ from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime.server import LogSink, ServerNode
 from kafka_ps_tpu.runtime.worker import WorkerNode
 from kafka_ps_tpu.utils.config import PSConfig, SEQUENTIAL
+from kafka_ps_tpu.utils.trace import NULL_TRACER
 
 
 class StreamingPSApp:
@@ -42,16 +43,19 @@ class StreamingPSApp:
                  test_y: np.ndarray | None = None,
                  server_log: LogSink | None = None,
                  worker_log: LogSink | None = None,
-                 clock_ms=None):
+                 clock_ms=None,
+                 tracer=None):
+        self.tracer = tracer or NULL_TRACER
         self.cfg = cfg
-        self.fabric = fabric_mod.Fabric()
+        self.fabric = fabric_mod.Fabric(tracer=self.tracer)
         self.buffers = [
             SlidingBuffer(cfg.model.num_features, cfg.buffer, clock_ms=clock_ms)
             for _ in range(cfg.num_workers)]
-        self.server = ServerNode(cfg, self.fabric, test_x, test_y, server_log)
+        self.server = ServerNode(cfg, self.fabric, test_x, test_y, server_log,
+                                 tracer=self.tracer)
         self.workers = [
             WorkerNode(w, cfg, self.fabric, self.buffers[w], test_x, test_y,
-                       worker_log)
+                       worker_log, tracer=self.tracer)
             for w in range(cfg.num_workers)]
         self._stop = threading.Event()
 
@@ -179,7 +183,13 @@ class StreamingPSApp:
             mask = np.stack([s[2] for s in slabs])
             if mesh is not None:
                 x, y, mask = bsp.shard_worker_batches(mesh, x, y, mask)
-            theta, mean_loss = step(theta, x, y, mask)
+            with self.tracer.span("bsp.step", clock=clock + 1):
+                theta, mean_loss = step(theta, x, y, mask)
+                if self.tracer.enabled:
+                    # sync so the span measures the real step, not the
+                    # async dispatch; untraced runs keep pipelining
+                    mean_loss = float(mean_loss)
+            self.tracer.count("bsp.steps")
             clock += 1
             self.server.iterations += self.cfg.num_workers
             self.server.theta = np.asarray(theta)

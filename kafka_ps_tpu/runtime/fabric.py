@@ -22,22 +22,30 @@ import threading
 from collections import deque
 from typing import Any
 
+from kafka_ps_tpu.utils.trace import NULL_TRACER, Tracer
+
 WEIGHTS_TOPIC = "weights"
 GRADIENTS_TOPIC = "gradients"
 INPUT_DATA_TOPIC = "input-data"
 
 
 class Fabric:
-    """Keyed FIFO queues with blocking and non-blocking consumption."""
+    """Keyed FIFO queues with blocking and non-blocking consumption.
 
-    def __init__(self):
+    Per-topic send counters on the tracer give the message-flow view the
+    reference got from its Confluent interceptors (BaseKafkaApp.java:73-78).
+    """
+
+    def __init__(self, tracer: Tracer | None = None):
         self._queues: dict[tuple[str, int], deque] = {}
         self._cond = threading.Condition()
+        self._tracer = tracer or NULL_TRACER
 
     def _q(self, topic: str, key: int) -> deque:
         return self._queues.setdefault((topic, key), deque())
 
     def send(self, topic: str, key: int, message: Any) -> None:
+        self._tracer.count(f"send.{topic}")
         with self._cond:
             self._q(topic, key).append(message)
             self._cond.notify_all()

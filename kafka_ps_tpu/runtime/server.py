@@ -37,6 +37,7 @@ from kafka_ps_tpu.parallel.tracker import MessageTracker
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime.messages import GradientMessage, KeyRange, WeightsMessage
 from kafka_ps_tpu.utils.config import EVENTUAL, PSConfig
+from kafka_ps_tpu.utils.trace import NULL_TRACER
 
 LogSink = Callable[[str], None]
 
@@ -47,7 +48,9 @@ class ServerNode:
     def __init__(self, cfg: PSConfig, fabric: fabric_mod.Fabric,
                  test_x: np.ndarray | None = None,
                  test_y: np.ndarray | None = None,
-                 log: LogSink | None = None):
+                 log: LogSink | None = None,
+                 tracer=None):
+        self.tracer = tracer or NULL_TRACER
         self.cfg = cfg
         self.fabric = fabric
         self.tracker = MessageTracker(cfg.num_workers)
@@ -119,15 +122,20 @@ class ServerNode:
 
     def process(self, msg: GradientMessage) -> None:
         self.tracker.received_message(msg.worker_id, msg.vector_clock)
+        self.tracer.count("server.gradients_applied")
 
-        r = msg.key_range
-        self.theta[r.start:r.end] += self.cfg.server_lr * msg.values
-        self.iterations += 1
+        with self.tracer.span("server.apply", worker=msg.worker_id,
+                              clock=msg.vector_clock):
+            r = msg.key_range
+            self.theta[r.start:r.end] += self.cfg.server_lr * msg.values
+            self.iterations += 1
 
         if (msg.worker_id == 0 and self.test_x is not None
                 and msg.vector_clock % self.cfg.eval_every == 0):
-            m = metrics_mod.evaluate(jnp.asarray(self.theta), self.test_x,
-                                     self.test_y, cfg=self.cfg.model)
+            with self.tracer.span("server.eval", clock=msg.vector_clock):
+                m = metrics_mod.evaluate(jnp.asarray(self.theta), self.test_x,
+                                         self.test_y, cfg=self.cfg.model)
+                m = metrics_mod.Metrics(*map(float, m))
             self.last_metrics = m
             # schema: timestamp;partition;vectorClock;loss;fMeasure;accuracy
             # (ServerAppRunner.java:81); partition=-1 like the reference,

@@ -25,6 +25,7 @@ from kafka_ps_tpu.models import metrics as metrics_mod
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime.messages import GradientMessage, KeyRange, WeightsMessage
 from kafka_ps_tpu.utils.config import PSConfig
+from kafka_ps_tpu.utils.trace import NULL_TRACER
 
 LogSink = Callable[[str], None]
 
@@ -37,7 +38,9 @@ class WorkerNode:
                  buffer: SlidingBuffer,
                  test_x: np.ndarray | None = None,
                  test_y: np.ndarray | None = None,
-                 log: LogSink | None = None):
+                 log: LogSink | None = None,
+                 tracer=None):
+        self.tracer = tracer or NULL_TRACER
         self.worker_id = worker_id
         self.cfg = cfg
         self.fabric = fabric
@@ -65,10 +68,12 @@ class WorkerNode:
             update_fn = fused_update.local_update
         else:
             update_fn = logreg.local_update
-        delta, loss = update_fn(
-            jnp.asarray(self.theta), jnp.asarray(x), jnp.asarray(y),
-            jnp.asarray(mask), cfg=self.cfg.model)
-        delta = np.asarray(delta)
+        with self.tracer.span("worker.local_update", worker=self.worker_id,
+                              clock=msg.vector_clock):
+            delta, loss = update_fn(
+                jnp.asarray(self.theta), jnp.asarray(x), jnp.asarray(y),
+                jnp.asarray(mask), cfg=self.cfg.model)
+            delta = np.asarray(delta)
 
         # Post-fit test metrics, like the reference's per-iteration eval
         # inside calculateGradients (LogisticRegressionTaskSpark.java:186).

@@ -1,0 +1,118 @@
+"""Tracing / profiling hooks — the reference's observability surface
+(Confluent monitoring interceptors on every producer/consumer feeding
+Control Center, BaseKafkaApp.java:73-78, dev/docker-compose.yaml:30-47)
+rebuilt for the TPU runtime.
+
+Three layers:
+  * `Tracer` — host-side span + counter recorder.  Spans export as
+    Chrome trace-event JSON (load in chrome://tracing or Perfetto);
+    counters give the message-flow view the Kafka interceptors provided
+    (sends per topic, iterations per worker).
+  * `Tracer.span(...)` context manager — wrap any section; thread-safe,
+    so the threaded runtime's per-worker threads can share one tracer.
+  * `device_trace(...)` — jax.profiler wrapper capturing XLA/TPU traces
+    (HLO timelines, per-op device time) to a TensorBoard logdir.
+
+Zero overhead when disabled: the module-level NULL_TRACER no-ops every
+call, and runtime code takes `tracer or NULL_TRACER`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import defaultdict
+
+
+class Tracer:
+    """Span + counter recorder with Chrome trace-event export."""
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._t0 = clock()
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self._counters: dict[str, int] = defaultdict(int)
+        self.enabled = True
+
+    # -- spans -------------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, **args):
+        if not self.enabled:
+            yield
+            return
+        start = self._clock()
+        try:
+            yield
+        finally:
+            end = self._clock()
+            with self._lock:
+                self._events.append({
+                    "name": name,
+                    "ph": "X",                      # complete event
+                    "ts": (start - self._t0) * 1e6,  # µs, trace convention
+                    "dur": (end - start) * 1e6,
+                    "pid": 0,
+                    "tid": threading.get_ident() % 2 ** 31,
+                    "args": args,
+                })
+
+    # -- counters (message-flow view) --------------------------------------
+    def count(self, name: str, n: int = 1) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._counters[name] += n
+
+    # -- export ------------------------------------------------------------
+    def counters(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def span_stats(self) -> dict[str, dict]:
+        """Per-span-name count/total/mean milliseconds."""
+        with self._lock:
+            acc: dict[str, list[float]] = defaultdict(list)
+            for e in self._events:
+                acc[e["name"]].append(e["dur"] / 1e3)
+        return {name: {"count": len(ds), "total_ms": round(sum(ds), 3),
+                       "mean_ms": round(sum(ds) / len(ds), 3)}
+                for name, ds in sorted(acc.items())}
+
+    def dump(self, path: str) -> str:
+        """Chrome trace-event JSON: {traceEvents: [...], counters: ...}."""
+        with self._lock:
+            payload = {"traceEvents": list(self._events),
+                       "counters": dict(self._counters)}
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path
+
+
+class _NullTracer(Tracer):
+    """No-op tracer (observability off — the default, like running the
+    reference without Control Center)."""
+
+    def __init__(self):
+        super().__init__()
+        self.enabled = False
+
+
+NULL_TRACER = _NullTracer()
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str | None):
+    """XLA/TPU device profiling via jax.profiler (per-op device time,
+    HLO timeline — view with TensorBoard).  None → no-op."""
+    if logdir is None:
+        yield
+        return
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,120 @@
+"""Tracing hooks: spans, counters, export, and runtime wiring."""
+
+import json
+import threading
+
+from kafka_ps_tpu.utils.trace import NULL_TRACER, Tracer
+
+
+def test_span_and_counter_recording(tmp_path):
+    clock_vals = iter([0.0, 0.0, 1.0, 1.5, 2.0, 5.0])   # t0 + 2 spans
+    t = Tracer(clock=lambda: next(clock_vals))
+    with t.span("a", worker=0):
+        pass
+    with t.span("a"):
+        pass
+    t.count("send.weights")
+    t.count("send.weights", 2)
+
+    stats = t.span_stats()
+    assert stats["a"]["count"] == 2
+    assert stats["a"]["total_ms"] == 1500.0   # (1.0-0.0) + (2.0-1.5) s
+    assert t.counters() == {"send.weights": 3}
+
+    path = t.dump(str(tmp_path / "trace.json"))
+    data = json.load(open(path))
+    assert len(data["traceEvents"]) == 2
+    ev = data["traceEvents"][0]
+    assert ev["ph"] == "X" and ev["dur"] == 1e6
+    assert ev["args"] == {"worker": 0}
+
+
+def test_span_records_on_exception():
+    clock_vals = iter([0.0, 1.0, 2.0])
+    t = Tracer(clock=lambda: next(clock_vals))
+    try:
+        with t.span("boom"):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    assert t.span_stats()["boom"]["count"] == 1
+
+
+def test_null_tracer_noops():
+    with NULL_TRACER.span("x"):
+        pass
+    NULL_TRACER.count("y")
+    assert NULL_TRACER.span_stats() == {}
+    assert NULL_TRACER.counters() == {}
+
+
+def test_thread_safety():
+    t = Tracer()
+
+    def work():
+        for _ in range(200):
+            with t.span("s"):
+                t.count("c")
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert t.span_stats()["s"]["count"] == 800
+    assert t.counters()["c"] == 800
+
+
+def test_runtime_emits_spans_and_counters():
+    """A serial run with a tracer produces the expected span names and
+    message-flow counters."""
+    import numpy as np
+    from kafka_ps_tpu.data.synth import generate
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+    from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
+                                           PSConfig)
+
+    cfg = PSConfig(
+        num_workers=2,
+        model=ModelConfig(num_features=16, num_classes=3),
+        buffer=BufferConfig(min_size=4, max_size=8),
+    )
+    x, y = generate(40, 16, 3, seed=0)
+    tracer = Tracer()
+    app = StreamingPSApp(cfg, test_x=x[-8:], test_y=y[-8:], tracer=tracer)
+    for i in range(16):
+        app.data_sink(i % 2, {j: float(x[i, j]) for j in range(16)},
+                      int(y[i]))
+    app.run_serial(max_server_iterations=4, pump=lambda: None)
+
+    stats = tracer.span_stats()
+    assert "worker.local_update" in stats
+    assert "server.apply" in stats
+    assert "server.eval" in stats
+    counters = tracer.counters()
+    assert counters["send.gradients"] >= 4
+    assert counters["send.weights"] >= 2
+    assert counters["server.gradients_applied"] >= 4
+
+
+def test_fused_path_emits_spans():
+    import numpy as np
+    from kafka_ps_tpu.data.synth import generate
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+    from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
+                                           PSConfig)
+
+    cfg = PSConfig(
+        num_workers=2,
+        model=ModelConfig(num_features=16, num_classes=3),
+        buffer=BufferConfig(min_size=4, max_size=8),
+    )
+    x, y = generate(40, 16, 3, seed=0)
+    tracer = Tracer()
+    app = StreamingPSApp(cfg, test_x=x[-8:], test_y=y[-8:], tracer=tracer)
+    for i in range(16):
+        app.data_sink(i % 2, {j: float(x[i, j]) for j in range(16)},
+                      int(y[i]))
+    app.run_fused_bsp(max_server_iterations=4)
+    assert tracer.span_stats()["bsp.step"]["count"] >= 2
+    assert tracer.counters()["bsp.steps"] >= 2
