@@ -76,6 +76,14 @@ def build_parser(include_server_flags: bool = True,
                    help="use the Pallas fused local-update kernel for "
                         "worker iterations (ops/fused_update.py; "
                         "auto-falls-back off-TPU)")
+    p.add_argument("--failure_policy", choices=["halt", "rebalance"],
+                   default="halt",
+                   help="threaded mode: evict crashed/hung workers and "
+                        "continue on the survivors (rebalance), or stop "
+                        "the run (halt)")
+    p.add_argument("--heartbeat_timeout", type=float, default=None,
+                   help="threaded+rebalance: seconds without worker "
+                        "progress (with work pending) before eviction")
     p.add_argument("--mode", choices=["threaded", "serial"],
                    default="threaded")
     p.add_argument("--checkpoint", default=None,
@@ -177,7 +185,9 @@ def run_with_args(args) -> int:
                 app.run_serial(max_server_iterations=max_iters,
                                pump=lambda: None)
             else:
-                app.run_threaded(max_server_iterations=max_iters)
+                app.run_threaded(max_server_iterations=max_iters,
+                                 failure_policy=args.failure_policy,
+                                 heartbeat_timeout=args.heartbeat_timeout)
     except KeyboardInterrupt:
         print("interrupted — shutting down", file=sys.stderr)
         app.stop()

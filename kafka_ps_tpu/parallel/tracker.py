@@ -17,10 +17,15 @@ import dataclasses
 class MessageStatus:
     """One worker's slot (MessageTracker.java:10-40).  Starts at clock 0
     with the bootstrap broadcast counted as already sent
-    (MessageTracker.java:47-53)."""
+    (MessageTracker.java:47-53).
+
+    `active=False` removes the worker from every gating predicate — the
+    failure-detection hook (the reference has no app-level equivalent;
+    it relies on Kafka consumer-group rebalancing, SURVEY §5)."""
 
     vector_clock: int = 0
     weights_message_sent: bool = True
+    active: bool = True
 
     def sent_message(self, vector_clock: int) -> None:
         if self.vector_clock != vector_clock:
@@ -50,7 +55,7 @@ class MessageTracker:
         self.tracker[worker].sent_message(vector_clock)
 
     def sent_all_messages(self, vector_clock: int) -> None:
-        for worker in range(self.num_workers):
+        for worker in self.active_workers:
             self.sent_message(worker, vector_clock)
 
     def get_all_sendable_messages(self, max_delay: int) -> list[tuple[int, int]]:
@@ -60,15 +65,46 @@ class MessageTracker:
         return [
             (worker, status.vector_clock)
             for worker, status in enumerate(self.tracker)
-            if not status.weights_message_sent
+            if status.active
+            and not status.weights_message_sent
             and self.has_received_all_messages(status.vector_clock - max_delay - 1)
         ]
 
     def has_received_all_messages(self, vector_clock: int) -> bool:
-        """True iff every worker's gradient for iteration `vector_clock`
-        has arrived, i.e. min clock >= vector_clock + 1
-        (MessageTracker.java:81-87)."""
-        return min(s.vector_clock for s in self.tracker) >= vector_clock + 1
+        """True iff every ACTIVE worker's gradient for iteration
+        `vector_clock` has arrived, i.e. min active clock >=
+        vector_clock + 1 (MessageTracker.java:81-87)."""
+        return min(s.vector_clock for s in self.tracker
+                   if s.active) >= vector_clock + 1
+
+    # -- membership (failure detection / elastic recovery hooks) -----------
+
+    @property
+    def active_workers(self) -> list[int]:
+        return [w for w, s in enumerate(self.tracker) if s.active]
+
+    def deactivate_worker(self, worker: int) -> None:
+        """Remove a failed worker from every gate — the sequential and
+        bounded-delay models stop waiting for its gradients (the
+        consumer-group-rebalance analogue).  At least one worker must
+        survive; the invariant is checked BEFORE mutating so concurrent
+        readers (the producer's reroute in data_sink) never observe an
+        empty active set."""
+        if not any(s.active for w, s in enumerate(self.tracker)
+                   if w != worker):
+            raise ValueError("cannot deactivate the last active worker")
+        self.tracker[worker].active = False
+
+    def reactivate_worker(self, worker: int) -> int:
+        """Readmit a worker at the slowest active clock (so no gate can
+        regress) with its reply pending.  Returns the join clock —
+        the caller sends it a fresh WeightsMessage at that clock."""
+        join_clock = min(s.vector_clock for s in self.tracker if s.active)
+        status = self.tracker[worker]
+        status.active = True
+        status.vector_clock = join_clock
+        status.weights_message_sent = False
+        return join_clock
 
     @property
     def clocks(self) -> list[int]:

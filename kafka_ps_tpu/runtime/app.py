@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 
 import numpy as np
 
@@ -58,11 +59,22 @@ class StreamingPSApp:
                        worker_log, tracer=self.tracer)
             for w in range(cfg.num_workers)]
         self._stop = threading.Event()
+        self._reroute_counter = 0
+        self.worker_failures: list[tuple[int, BaseException | str]] = []
 
     # -- ingestion sink (the INPUT_DATA topic hop) -------------------------
 
     def data_sink(self, worker: int, features: dict[int, float],
                   label: int) -> None:
+        status = self.server.tracker.tracker[worker]
+        if not status.active:
+            # partition reassignment: rows destined for an evicted worker
+            # go round-robin to the survivors (the Kafka consumer-group
+            # rebalance analogue, SURVEY §5)
+            active = self.server.tracker.active_workers
+            worker = active[self._reroute_counter % len(active)]
+            self._reroute_counter += 1
+            self.tracer.count("data.rerouted_rows")
         self.buffers[worker].add(features, label)
 
     def make_producer(self, csv_path: str, has_header: bool = True,
@@ -117,12 +129,31 @@ class StreamingPSApp:
                 raise RuntimeError("deadlock: no deliverable messages")
 
     def run_threaded(self, max_server_iterations: int,
-                     poll_timeout: float = 0.1) -> None:
+                     poll_timeout: float = 0.1,
+                     failure_policy: str = "halt",
+                     heartbeat_timeout: float | None = None) -> None:
         """One thread per worker (the reference's stream threads); server
-        on the calling thread."""
+        on the calling thread, doubling as the supervisor.
+
+        Failure handling (the reference delegates this to Kafka
+        consumer-group rebalancing + k8s restarts, SURVEY §5):
+          * `failure_policy="halt"` — any worker exception stops the run
+            and re-raises (the previous behavior, and the right default
+            for tests);
+          * `failure_policy="rebalance"` — a crashed worker (exception)
+            or a hung worker (no completed iteration within
+            `heartbeat_timeout` seconds despite pending weights
+            messages) is evicted: the consistency gates stop waiting for
+            it, its stream partition reroutes to the survivors
+            (data_sink), and its in-flight gradients are dropped as
+            zombies.  Training continues on the remaining workers.
+        """
+        if failure_policy not in ("halt", "rebalance"):
+            raise ValueError(f"unknown failure_policy {failure_policy!r}")
         self._stop.clear()
 
         worker_errors: list[BaseException] = []
+        failed_q: deque[tuple[int, BaseException]] = deque()
 
         def worker_loop(worker: WorkerNode):
             try:
@@ -132,15 +163,54 @@ class StreamingPSApp:
                         timeout=poll_timeout)
                     if msg is not None:
                         worker.on_weights(msg)
-            except BaseException as e:   # surface worker death to the server loop
-                worker_errors.append(e)
-                self._stop.set()
+            except BaseException as e:   # surface worker death to the server
+                if failure_policy == "rebalance":
+                    failed_q.append((worker.worker_id, e))
+                else:
+                    worker_errors.append(e)
+                    self._stop.set()
 
-        threads = [threading.Thread(target=worker_loop, args=(w,), daemon=True,
-                                    name=f"worker-{w.worker_id}")
-                   for w in self.workers]
-        for t in threads:
+        threads = {w.worker_id: threading.Thread(
+                       target=worker_loop, args=(w,), daemon=True,
+                       name=f"worker-{w.worker_id}")
+                   for w in self.workers}
+        for t in threads.values():
             t.start()
+
+        def evict(worker_id: int, reason) -> None:
+            try:
+                self.server.remove_worker(worker_id)
+            except ValueError:      # last active worker: halt instead
+                self._stop.set()
+                worker_errors.append(
+                    reason if isinstance(reason, BaseException)
+                    else RuntimeError(f"worker {worker_id}: {reason}"))
+                return
+            self.worker_failures.append((worker_id, reason))
+
+        def supervise() -> None:
+            # crashed workers enqueue themselves before their thread
+            # exits, so failed_q is the complete crash-detection channel
+            while failed_q:
+                w, err = failed_q.popleft()
+                evict(w, err)
+            if heartbeat_timeout is None:
+                return
+            now = time.monotonic()
+            for w in list(self.server.tracker.active_workers):
+                # weights_message_sent == the worker owes a gradient; but
+                # a gradient already delivered to the queue (waiting on a
+                # slow server — e.g. eval first-compile) is the server's
+                # latency, not the worker's: don't count it
+                hung = (self.server.tracker.tracker[w].weights_message_sent
+                        and not self.fabric.contains(
+                            fabric_mod.GRADIENTS_TOPIC, 0,
+                            lambda m, w=w: m.worker_id == w)
+                        and now - self.workers[w].last_progress
+                        > heartbeat_timeout)
+                if hung:
+                    evict(w, f"no heartbeat for {heartbeat_timeout}s")
+
         try:
             self.server.start_training_loop()
             while self.server.iterations < max_server_iterations:
@@ -150,9 +220,11 @@ class StreamingPSApp:
                                               timeout=poll_timeout)
                 if g is not None:
                     self.server.process(g)
+                if failure_policy == "rebalance":
+                    supervise()
         finally:
             self._stop.set()
-            for t in threads:
+            for t in threads.values():
                 t.join(timeout=5.0)
         if worker_errors:
             raise RuntimeError("worker thread failed") from worker_errors[0]

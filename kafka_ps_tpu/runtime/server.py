@@ -60,6 +60,7 @@ class ServerNode:
         self.log = log or (lambda line: None)
         self.iterations = 0          # total gradient messages applied
         self.last_metrics = None
+        self._loop_started = False   # bootstrap broadcast done once
         # optional periodic checkpointing (utils/checkpoint.py)
         self.checkpoint_path: str | None = None
         self.checkpoint_every: int = 50   # <= 0: only save on exit
@@ -78,25 +79,29 @@ class ServerNode:
         through the consistency gate — only those currently eligible are
         re-issued, so restored runs respect the same staleness bounds.
         """
+        if self._loop_started:
+            # resuming a drive loop on a live system: the in-flight
+            # messages are still in the fabric; re-broadcasting would
+            # double-deliver and break the clock protocol
+            return
+        self._loop_started = True
         for worker, status in enumerate(self.tracker.tracker):
-            if status.weights_message_sent:
+            if status.active and status.weights_message_sent:
                 self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
                                  self._weights_message(status.vector_clock))
         delay = self.cfg.max_vector_clock_delay
         if delay == EVENTUAL:
             # eventual answers immediately, so any surviving pending
             # reply is re-issued at once
-            pending = [(w, s.vector_clock)
-                       for w, s in enumerate(self.tracker.tracker)
-                       if not s.weights_message_sent]
+            for worker, s in enumerate(self.tracker.tracker):
+                if s.active and not s.weights_message_sent:
+                    self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
+                                     self._weights_message(s.vector_clock))
+                    self.tracker.sent_message(worker, s.vector_clock)
         else:
             # sequential == bounded with delay 0: the tracker's own
             # sendable predicate (MessageTracker.java:69-79)
-            pending = self.tracker.get_all_sendable_messages(max(delay, 0))
-        for worker, clock in pending:
-            self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
-                             self._weights_message(clock))
-            self.tracker.sent_message(worker, clock)
+            self._flush_gate()
 
     def _weights_message(self, vector_clock: int) -> WeightsMessage:
         return WeightsMessage(
@@ -114,13 +119,58 @@ class ServerNode:
         if delay == 0:
             if self.tracker.has_received_all_messages(received_vc):
                 return {(w, received_vc + 1)
-                        for w in range(self.cfg.num_workers)}
+                        for w in self.tracker.active_workers}
             return set()
         return set(self.tracker.get_all_sendable_messages(delay))
+
+    # -- membership: failure detection / elastic recovery ------------------
+    # The reference delegates both to the platform (Kafka consumer-group
+    # rebalancing + k8s pod restarts, SURVEY §5); here they are runtime
+    # APIs driven by the supervisor in runtime/app.py.
+
+    def remove_worker(self, worker: int) -> None:
+        """Evict a failed worker: every consistency gate stops waiting
+        for its gradients, and any round it was blocking is released."""
+        self.tracker.deactivate_worker(worker)
+        self.tracer.count("server.workers_removed")
+        self._flush_gate()
+
+    def readmit_worker(self, worker: int) -> int:
+        """Elastic scale-up: rejoin at the slowest active clock with the
+        current weights (the state-store-restore analogue)."""
+        # drain any pre-eviction in-flight traffic: a stale gradient (or
+        # stale queued weights) becoming "live" again would break the
+        # clock protocol
+        self.fabric.purge(fabric_mod.GRADIENTS_TOPIC, 0,
+                          lambda m: getattr(m, "worker_id", None) == worker)
+        self.fabric.purge(fabric_mod.WEIGHTS_TOPIC, worker, lambda m: True)
+        clock = self.tracker.reactivate_worker(worker)
+        self.tracer.count("server.workers_readmitted")
+        self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
+                         self._weights_message(clock))
+        self.tracker.sent_message(worker, clock)
+        return clock
+
+    def _flush_gate(self) -> None:
+        """Send every reply the gate now permits (used after membership
+        changes — a removal can unblock rounds the dead worker held up)."""
+        delay = self.cfg.max_vector_clock_delay
+        if delay == EVENTUAL:
+            return
+        for worker, clock in self.tracker.get_all_sendable_messages(
+                max(delay, 0)):
+            self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
+                             self._weights_message(clock))
+            self.tracker.sent_message(worker, clock)
 
     # -- the hot path (ServerProcessor.java:143-183) -----------------------
 
     def process(self, msg: GradientMessage) -> None:
+        if not self.tracker.tracker[msg.worker_id].active:
+            # in-flight gradient from an evicted worker (zombie): drop it
+            # rather than corrupt the vector-clock protocol
+            self.tracer.count("server.zombie_gradients_dropped")
+            return
         self.tracker.received_message(msg.worker_id, msg.vector_clock)
         self.tracer.count("server.gradients_applied")
 

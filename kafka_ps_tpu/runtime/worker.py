@@ -50,8 +50,14 @@ class WorkerNode:
         self.test_y = jnp.asarray(test_y) if test_y is not None else None
         self.log = log or (lambda line: None)
         self.iterations = 0
+        # failure-detection heartbeat (read by the supervisor in
+        # runtime/app.py): wall-clock of the last completed iteration
+        self.last_progress = time.monotonic()
 
     def on_weights(self, msg: WeightsMessage) -> None:
+        # heartbeat: starting an iteration counts as liveness, so a slow
+        # (e.g. first-compile) iteration is measured from its own start
+        self.last_progress = time.monotonic()
         # Overwrite the local replica with the server's parameters
         # (WorkerTrainingProcessor.java:72).
         r = msg.key_range
@@ -99,3 +105,4 @@ class WorkerNode:
                 key_range=KeyRange(0, self.cfg.model.num_params),
                 values=delta,
                 worker_id=self.worker_id))
+        self.last_progress = time.monotonic()

@@ -23,6 +23,8 @@ def save(path: str, server) -> None:
         clocks=np.asarray(server.tracker.clocks, dtype=np.int64),
         sent=np.asarray([s.weights_message_sent for s in server.tracker.tracker],
                         dtype=bool),
+        active=np.asarray([s.active for s in server.tracker.tracker],
+                          dtype=bool),
         iterations=np.asarray(server.iterations, dtype=np.int64))
     os.replace(tmp, path)
 
@@ -36,10 +38,15 @@ def restore(path: str, server) -> None:
         if len(z["clocks"]) != len(server.tracker.tracker):
             raise ValueError("checkpoint worker count mismatch")
         server.theta = z["theta"].copy()
-        for status, clock, sent in zip(server.tracker.tracker, z["clocks"],
-                                       z["sent"]):
+        # checkpoints from before worker eviction existed have no
+        # `active` field: treat every worker as active
+        active = (z["active"] if "active" in z.files
+                  else np.ones(len(z["clocks"]), dtype=bool))
+        for status, clock, sent, act in zip(server.tracker.tracker,
+                                            z["clocks"], z["sent"], active):
             status.vector_clock = int(clock)
             status.weights_message_sent = bool(sent)
+            status.active = bool(act)
         server.iterations = int(z["iterations"])
 
 

@@ -1,0 +1,234 @@
+"""Failure detection + elastic recovery: tracker membership, server
+eviction/readmission, data rerouting, and the supervised threaded
+runtime with fault injection (the reference delegates all of this to
+Kafka consumer-group rebalancing + k8s restarts, SURVEY §5)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.data.synth import generate
+from kafka_ps_tpu.parallel.tracker import MessageTracker
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime.app import StreamingPSApp
+from kafka_ps_tpu.utils.config import (BufferConfig, EVENTUAL, ModelConfig,
+                                       PSConfig)
+
+CFG_KW = dict(
+    model=ModelConfig(num_features=16, num_classes=3),
+    buffer=BufferConfig(min_size=4, max_size=8),
+)
+
+
+def _make_app(num_workers=3, consistency=0, **kw):
+    cfg = PSConfig(num_workers=num_workers, consistency_model=consistency,
+                   **CFG_KW)
+    x, y = generate(80, 16, 3, seed=0)
+    app = StreamingPSApp(cfg, test_x=x[-8:], test_y=y[-8:], **kw)
+    for i in range(num_workers * 8):
+        app.data_sink(i % num_workers,
+                      {j: float(x[i, j]) for j in range(16)}, int(y[i]))
+    return app
+
+
+# -- tracker membership ----------------------------------------------------
+
+def test_tracker_deactivate_releases_gate():
+    t = MessageTracker(3)
+    t.received_message(0, 0)
+    t.received_message(1, 0)
+    # worker 2 never reports: sequential gate blocked
+    assert not t.has_received_all_messages(0)
+    t.deactivate_worker(2)
+    assert t.has_received_all_messages(0)
+    assert t.active_workers == [0, 1]
+    assert all(w != 2 for w, _ in t.get_all_sendable_messages(0))
+
+
+def test_tracker_cannot_deactivate_last_worker():
+    t = MessageTracker(2)
+    t.deactivate_worker(0)
+    with pytest.raises(ValueError, match="last active worker"):
+        t.deactivate_worker(1)
+    assert t.tracker[1].active   # rolled back
+
+
+def test_tracker_reactivate_joins_at_slowest_clock():
+    t = MessageTracker(3)
+    t.deactivate_worker(2)
+    for clock in range(4):
+        for w in (0, 1):
+            t.received_message(w, clock)
+            t.sent_message(w, clock + 1)
+    join = t.reactivate_worker(2)
+    assert join == 4
+    assert t.tracker[2].active and not t.tracker[2].weights_message_sent
+    # the rejoined worker cannot regress any gate
+    assert t.has_received_all_messages(3)
+
+
+# -- server eviction / readmission (serial, deterministic) -----------------
+
+def test_sequential_run_survives_worker_death():
+    app = _make_app(num_workers=3)
+    app.run_serial(max_server_iterations=3, pump=lambda: None)
+    theta_before = app.server.theta.copy()
+
+    app.server.remove_worker(2)
+    # worker 2's in-flight weights message will produce a zombie gradient;
+    # the run must keep progressing on workers 0-1 regardless
+    app.run_serial(max_server_iterations=9, pump=lambda: None)
+    assert app.server.iterations >= 9
+    assert not np.array_equal(app.server.theta, theta_before)
+    assert 2 not in app.server.tracker.active_workers
+
+
+def test_zombie_gradient_dropped():
+    app = _make_app(num_workers=2)
+    app.server.start_training_loop()
+    # deliver weights to both, but evict worker 1 before its gradient lands
+    for w in (0, 1):
+        msg = app.fabric.poll(fabric_mod.WEIGHTS_TOPIC, w)
+        app.workers[w].on_weights(msg)
+    app.server.remove_worker(1)
+    applied_before = app.server.iterations
+    for _ in range(2):
+        g = app.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0)
+        if g is not None:
+            app.server.process(g)
+    # only worker 0's gradient applied; worker 1's dropped silently
+    assert app.server.iterations == applied_before + 1
+    assert app.server.tracker.clocks[1] == 0
+
+
+def test_readmission_rejoins_and_contributes():
+    app = _make_app(num_workers=3)
+    app.run_serial(max_server_iterations=3, pump=lambda: None)
+    app.server.remove_worker(1)
+    app.run_serial(max_server_iterations=7, pump=lambda: None)
+
+    clock = app.server.readmit_worker(1)
+    assert clock == min(app.server.tracker.clocks[0],
+                        app.server.tracker.clocks[2])
+    before = app.workers[1].iterations
+    app.run_serial(max_server_iterations=13, pump=lambda: None)
+    assert app.workers[1].iterations > before
+    assert app.server.tracker.tracker[1].active
+
+
+def test_data_rerouted_from_dead_worker():
+    app = _make_app(num_workers=3)
+    app.server.remove_worker(2)
+    seen_before = [b.num_tuples_seen for b in app.buffers]
+    x, y = generate(30, 16, 3, seed=9)
+    for i in range(30):
+        app.data_sink(2, {j: float(x[i, j]) for j in range(16)}, int(y[i]))
+    assert app.buffers[2].num_tuples_seen == seen_before[2]  # nothing lands
+    # all 30 rows landed on the survivors, split round-robin
+    for w in (0, 1):
+        assert app.buffers[w].num_tuples_seen == seen_before[w] + 15
+
+
+def test_readmission_drains_zombie_gradient():
+    app = _make_app(num_workers=2)
+    app.server.start_training_loop()
+    for w in (0, 1):
+        app.workers[w].on_weights(app.fabric.poll(fabric_mod.WEIGHTS_TOPIC, w))
+    # both gradients queued; evict 1, process 0's gradient, then readmit 1
+    app.server.remove_worker(1)
+    app.server.process(app.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0))
+    app.server.readmit_worker(1)
+    # worker 1's stale vc=0 gradient must have been purged: the only
+    # remaining gradient traffic is none, and processing continues clean
+    g = app.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0)
+    assert g is None
+    # the readmission weights message carries the join clock
+    msg = app.fabric.poll(fabric_mod.WEIGHTS_TOPIC, 1)
+    assert msg.vector_clock == app.server.tracker.clocks[1]
+
+
+def test_checkpoint_roundtrips_active_flags(tmp_path):
+    from kafka_ps_tpu.utils import checkpoint as ckpt
+    app = _make_app(num_workers=3)
+    app.run_serial(max_server_iterations=3, pump=lambda: None)
+    app.server.remove_worker(1)
+    path = str(tmp_path / "ckpt.npz")
+    ckpt.save(path, app.server)
+
+    app2 = _make_app(num_workers=3)
+    ckpt.restore(path, app2.server)
+    assert app2.server.tracker.active_workers == [0, 2]
+    assert app2.server.tracker.clocks == app.server.tracker.clocks
+    # restored run keeps training without resurrecting the evicted worker
+    app2.run_serial(max_server_iterations=app2.server.iterations + 4,
+                    pump=lambda: None)
+    assert 1 not in app2.server.tracker.active_workers
+
+
+# -- threaded runtime with fault injection ---------------------------------
+
+class _CrashAfter:
+    """Fault injector: wraps on_weights, raises on the nth call."""
+
+    def __init__(self, worker, n):
+        self.worker = worker
+        self.n = n
+        self.calls = 0
+        self._orig = worker.on_weights
+        worker.on_weights = self
+
+    def __call__(self, msg):
+        self.calls += 1
+        if self.calls > self.n:
+            raise RuntimeError("injected worker fault")
+        return self._orig(msg)
+
+
+def test_threaded_halt_policy_raises():
+    app = _make_app(num_workers=2)
+    _CrashAfter(app.workers[1], 1)
+    with pytest.raises(RuntimeError, match="worker thread failed"):
+        app.run_threaded(max_server_iterations=50, poll_timeout=0.02)
+
+
+def test_threaded_rebalance_survives_crash():
+    app = _make_app(num_workers=3)
+    _CrashAfter(app.workers[1], 1)
+    app.run_threaded(max_server_iterations=12, poll_timeout=0.02,
+                     failure_policy="rebalance")
+    assert app.server.iterations >= 12
+    assert [w for w, _ in app.worker_failures] == [1]
+    assert 1 not in app.server.tracker.active_workers
+
+
+def test_threaded_rebalance_evicts_hung_worker():
+    app = _make_app(num_workers=3)
+    # warm the jit caches so iteration time << heartbeat timeout
+    app.run_serial(max_server_iterations=3, pump=lambda: None)
+
+    # fault injector: worker 1 hangs on its next iteration
+    hang = threading.Event()
+
+    def hanging(msg):
+        hang.wait(timeout=30)
+
+    app.workers[1].on_weights = hanging
+    try:
+        app.run_threaded(max_server_iterations=20, poll_timeout=0.02,
+                         failure_policy="rebalance", heartbeat_timeout=0.5)
+    finally:
+        hang.set()
+    assert app.server.iterations >= 20
+    assert any(w == 1 and "heartbeat" in str(r)
+               for w, r in app.worker_failures)
+
+
+def test_threaded_rebalance_halts_when_no_workers_left():
+    app = _make_app(num_workers=2)
+    _CrashAfter(app.workers[0], 1)
+    _CrashAfter(app.workers[1], 1)
+    with pytest.raises(RuntimeError, match="worker thread failed"):
+        app.run_threaded(max_server_iterations=100, poll_timeout=0.02,
+                         failure_policy="rebalance")
