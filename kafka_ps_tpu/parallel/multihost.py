@@ -1,0 +1,104 @@
+"""Multi-host distributed backend — scaling the PS pattern past one host.
+
+The reference scales out by pointing every JVM at one Kafka broker
+(`-r/--remote`, broker kafka:9092, ServerAppRunner.java:63; k8s
+Deployments in kubernetes/server.yaml + worker.yaml).  The TPU-native
+equivalent is a JAX multi-process (multi-host) job: one Python process
+per host, `jax.distributed` as the control plane (the broker's role:
+membership + rendezvous), and one global `Mesh` whose collectives ride
+ICI within a host and DCN across hosts.
+
+Design rules (the scaling-book recipe):
+  * the worker axis is laid out host-major — logical workers on the same
+    host are mesh-adjacent, so the BSP `psum` does its partial reduction
+    over ICI first and only the per-host partials cross DCN;
+  * every host feeds only its own workers' buffers (the producer's
+    round-robin becomes host-local round-robin, like per-broker
+    partitions);
+  * the jit'd step is identical single-host and multi-host — shard_map
+    over the global mesh handles both; only array construction differs
+    (`jax.make_array_from_process_local_data`).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kafka_ps_tpu.parallel.mesh import WORKER_AXIS
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> bool:
+    """Join the multi-host job (jax.distributed — the broker-rendezvous
+    analogue).  No-op single-process run when unconfigured: returns False.
+
+    Configuration precedence: explicit args > KPS_COORDINATOR /
+    KPS_NUM_PROCESSES / KPS_PROCESS_ID env vars > cloud auto-detection
+    (jax.distributed.initialize() with no args on TPU pods).
+    """
+    coordinator_address = (coordinator_address
+                          or os.environ.get("KPS_COORDINATOR"))
+    if num_processes is None and "KPS_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["KPS_NUM_PROCESSES"])
+    if process_id is None and "KPS_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["KPS_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        return False          # single-process deployment
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    return True
+
+
+def global_worker_mesh() -> Mesh:
+    """1-D mesh over every device in the job, host-major (jax.devices()
+    orders by process), so the worker axis reduces over ICI first and
+    DCN last."""
+    return Mesh(np.asarray(jax.devices()), (WORKER_AXIS,))
+
+
+def local_worker_ids(num_workers: int,
+                     mesh: Mesh | None = None) -> list[int]:
+    """The logical workers this process hosts.
+
+    Workers are block-assigned to mesh positions (num_workers must be a
+    multiple of the device count, parallel/bsp.py); a device owns
+    workers [pos*k, (pos+1)*k) and a process owns its local devices'
+    blocks.  The stream producer on this host feeds exactly these
+    (host-local round-robin — the per-broker-partition analogue)."""
+    mesh = mesh or global_worker_mesh()
+    devices = list(mesh.devices.flat)
+    n = len(devices)
+    if num_workers % n != 0:
+        raise ValueError(
+            f"num_workers {num_workers} must be a multiple of the mesh "
+            f"size {n}")
+    per_device = num_workers // n
+    mine = []
+    for pos, d in enumerate(devices):
+        if d.process_index == jax.process_index():
+            mine.extend(range(pos * per_device, (pos + 1) * per_device))
+    return mine
+
+
+def shard_worker_batches_global(mesh: Mesh, local_x: np.ndarray,
+                                local_y: np.ndarray, local_mask: np.ndarray):
+    """Assemble the global [num_workers, cap, ...] arrays from each
+    process's local worker slabs (this host's local_worker_ids order).
+    Single-process: equivalent to bsp.shard_worker_batches."""
+    sharding = NamedSharding(mesh, P(WORKER_AXIS))
+    return tuple(
+        jax.make_array_from_process_local_data(sharding, a)
+        for a in (local_x, local_y, local_mask))
+
+
+def unreplicate(x) -> np.ndarray:
+    """Fetch a replicated global array to the host (works multi-process:
+    replicated values are fully addressable everywhere)."""
+    return np.asarray(jax.device_get(x))

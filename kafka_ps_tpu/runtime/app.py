@@ -90,7 +90,8 @@ class StreamingPSApp:
         """The reference sleeps 20 s after starting the producer
         (ServerAppRunner.java:95); we wait on the actual invariant."""
         deadline = time.monotonic() + timeout
-        while any(b.count < min_per_worker for b in self.buffers):
+        while any(self.buffers[w].count < min_per_worker
+                  for w in self.server.tracker.active_workers):
             if time.monotonic() > deadline:
                 raise TimeoutError("buffers not prefilled in time")
             time.sleep(0.01)
@@ -151,6 +152,7 @@ class StreamingPSApp:
         if failure_policy not in ("halt", "rebalance"):
             raise ValueError(f"unknown failure_policy {failure_policy!r}")
         self._stop.clear()
+        self.worker_failures = []    # this run's eviction record
 
         worker_errors: list[BaseException] = []
         failed_q: deque[tuple[int, BaseException]] = deque()
@@ -178,6 +180,9 @@ class StreamingPSApp:
             t.start()
 
         def evict(worker_id: int, reason) -> None:
+            if not self.server.tracker.tracker[worker_id].active:
+                return              # already evicted (e.g. heartbeat beat
+                                    # the thread's own crash report)
             try:
                 self.server.remove_worker(worker_id)
             except ValueError:      # last active worker: halt instead
@@ -198,16 +203,22 @@ class StreamingPSApp:
                 return
             now = time.monotonic()
             for w in list(self.server.tracker.active_workers):
-                # weights_message_sent == the worker owes a gradient; but
-                # a gradient already delivered to the queue (waiting on a
-                # slow server — e.g. eval first-compile) is the server's
-                # latency, not the worker's: don't count it
+                # Hung = owes a gradient (weights_message_sent) AND the
+                # owed gradient is not already queued behind a slow
+                # server AND no liveness signal within the timeout.
+                # Staleness is measured from the LATEST of (worker's own
+                # last progress, server's weights-send stamp) so time a
+                # worker spent gate-blocked and idle doesn't count
+                # against it.  A worker on its very first iteration gets
+                # 10x grace: the first call pays jit compilation.
+                grace = (10.0 if self.workers[w].iterations == 0 else 1.0)
+                baseline = max(self.workers[w].last_progress,
+                               self.server.weights_sent_at[w])
                 hung = (self.server.tracker.tracker[w].weights_message_sent
                         and not self.fabric.contains(
                             fabric_mod.GRADIENTS_TOPIC, 0,
                             lambda m, w=w: m.worker_id == w)
-                        and now - self.workers[w].last_progress
-                        > heartbeat_timeout)
+                        and now - baseline > heartbeat_timeout * grace)
                 if hung:
                     evict(w, f"no heartbeat for {heartbeat_timeout}s")
 
@@ -237,14 +248,19 @@ class StreamingPSApp:
 
         if self.cfg.consistency_model != SEQUENTIAL:
             raise ValueError("fused path implements the sequential model only")
-        step = bsp.make_bsp_step(self.cfg.model, self.cfg.num_workers,
+        # membership-aware: only active workers participate (a restored
+        # checkpoint may carry evictions; their buffers are starved by
+        # the data reroute and their tracker slots must stay frozen)
+        active = self.server.tracker.active_workers
+        step = bsp.make_bsp_step(self.cfg.model, len(active),
                                  self.cfg.server_lr, mesh=mesh)
         theta = jnp.asarray(self.server.theta)
-        # under BSP all clocks are uniform; resume from the restored one
-        clock = min(self.server.tracker.clocks)
+        # under BSP all active clocks are uniform; resume from the
+        # restored one
+        clock = min(self.server.tracker.clocks[w] for w in active)
         while self.server.iterations < max_server_iterations:
             slabs = []
-            for w in range(self.cfg.num_workers):
+            for w in active:
                 x, y, mask = self.buffers[w].snapshot()
                 if mask.sum() == 0:
                     raise RuntimeError(
@@ -263,10 +279,10 @@ class StreamingPSApp:
                     mean_loss = float(mean_loss)
             self.tracer.count("bsp.steps")
             clock += 1
-            self.server.iterations += self.cfg.num_workers
+            self.server.iterations += len(active)
             self.server.theta = np.asarray(theta)
-            for w, worker in enumerate(self.workers):
-                worker.iterations += 1
+            for w in active:
+                self.workers[w].iterations += 1
                 self.server.tracker.tracker[w].vector_clock = clock
                 self.server.tracker.tracker[w].weights_message_sent = True
             self.server.maybe_checkpoint()
@@ -285,8 +301,8 @@ class StreamingPSApp:
                 # step returns the mean local training loss; test metrics
                 # are identical across workers under BSP (replicated
                 # weights), so each line carries the shared values.
-                for w, worker in enumerate(self.workers):
-                    worker.log(
+                for w in active:
+                    self.workers[w].log(
                         f"{now};{w};{clock};{float(mean_loss)};"
                         f"{float(m.f1)};{float(m.accuracy)};"
                         f"{self.buffers[w].num_tuples_seen}")

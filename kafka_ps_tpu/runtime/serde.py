@@ -1,0 +1,141 @@
+"""Message serialization — the reference's serialization/ package
+(JSONSerde.java:12-59 + the polymorphic `_t` type registry,
+JSONSerdeCompatible.java:12-23) rebuilt for this runtime.
+
+Two codecs over the same type registry:
+
+  * JSON — wire-compatible in spirit with the reference (every payload
+    carries a `_t` discriminator; parameter values keyed by position),
+    for debugging and cross-language interop.
+  * Binary — length-prefixed struct header + raw little-endian float32
+    buffers, zero parsing on the hot path.  This is the DCN transport
+    format: a 6150-float WeightsMessage is ~24 KB of contiguous bytes
+    instead of ~120 KB of JSON (the reference ships full-model JSON both
+    directions every iteration and lists compression as future work,
+    README.md:333).
+
+The in-process fabric (runtime/fabric.py) passes objects by reference
+and needs neither; serde sits on the process boundary — multi-host
+transport, spill-to-disk, cross-language bridges.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+from kafka_ps_tpu.runtime.messages import (GradientMessage, KeyRange,
+                                           LabeledData, WeightsMessage)
+
+MAGIC = b"KPS1"
+
+# the `_t` registry (JSONSerdeCompatible.java:12-23)
+_TYPE_IDS = {
+    "WeightsMessage": 1,
+    "GradientMessage": 2,
+    "LabeledData": 3,
+}
+_ID_TYPES = {v: k for k, v in _TYPE_IDS.items()}
+
+
+# -- JSON codec ------------------------------------------------------------
+
+def to_json(msg) -> str:
+    if isinstance(msg, GradientMessage):      # subclass first
+        body = {"_t": "GradientMessage", "vectorClock": msg.vector_clock,
+                "keyRange": [msg.key_range.start, msg.key_range.end],
+                "values": [float(v) for v in msg.values],
+                "partitionKey": msg.worker_id}
+    elif isinstance(msg, WeightsMessage):
+        body = {"_t": "WeightsMessage", "vectorClock": msg.vector_clock,
+                "keyRange": [msg.key_range.start, msg.key_range.end],
+                "values": [float(v) for v in msg.values]}
+    elif isinstance(msg, LabeledData):
+        body = {"_t": "LabeledData",
+                "inputData": {str(k): float(v)
+                              for k, v in msg.features.items()},
+                "label": msg.label}
+    else:
+        raise TypeError(f"unregistered message type {type(msg).__name__}")
+    return json.dumps(body)
+
+
+def from_json(payload: str):
+    body = json.loads(payload)
+    t = body.get("_t")
+    if t == "WeightsMessage":
+        return WeightsMessage(
+            vector_clock=int(body["vectorClock"]),
+            key_range=KeyRange(*body["keyRange"]),
+            values=np.asarray(body["values"], dtype=np.float32))
+    if t == "GradientMessage":
+        return GradientMessage(
+            vector_clock=int(body["vectorClock"]),
+            key_range=KeyRange(*body["keyRange"]),
+            values=np.asarray(body["values"], dtype=np.float32),
+            worker_id=int(body["partitionKey"]))
+    if t == "LabeledData":
+        return LabeledData(
+            features={int(k): float(v)
+                      for k, v in body["inputData"].items()},
+            label=int(body["label"]))
+    raise ValueError(f"unknown message type tag {t!r}")
+
+
+# -- binary codec (the DCN hot path) ---------------------------------------
+
+_HEADER = struct.Struct("<4sBq")          # magic, type id, vector_clock
+_RANGE = struct.Struct("<qqq")            # start, end, worker_id
+
+
+def to_bytes(msg) -> bytes:
+    if isinstance(msg, (GradientMessage, WeightsMessage)):
+        tid = _TYPE_IDS[("GradientMessage"
+                         if isinstance(msg, GradientMessage)
+                         else "WeightsMessage")]
+        worker = msg.worker_id if isinstance(msg, GradientMessage) else 0
+        values = np.ascontiguousarray(msg.values, dtype="<f4")
+        return (_HEADER.pack(MAGIC, tid, msg.vector_clock)
+                + _RANGE.pack(msg.key_range.start, msg.key_range.end, worker)
+                + values.tobytes())
+    if isinstance(msg, LabeledData):
+        keys = np.fromiter(msg.features.keys(), dtype="<i4",
+                           count=len(msg.features))
+        vals = np.fromiter(msg.features.values(), dtype="<f4",
+                           count=len(msg.features))
+        return (_HEADER.pack(MAGIC, _TYPE_IDS["LabeledData"], msg.label)
+                + struct.pack("<q", len(keys))
+                + keys.tobytes() + vals.tobytes())
+    raise TypeError(f"unregistered message type {type(msg).__name__}")
+
+
+def from_bytes(payload: bytes):
+    magic, tid, clock_or_label = _HEADER.unpack_from(payload, 0)
+    if magic != MAGIC:
+        raise ValueError("bad magic — not a KPS1 message")
+    off = _HEADER.size
+    name = _ID_TYPES.get(tid)
+    if name in ("WeightsMessage", "GradientMessage"):
+        start, end, worker = _RANGE.unpack_from(payload, off)
+        off += _RANGE.size
+        values = np.frombuffer(payload, dtype="<f4", offset=off,
+                               count=end - start).copy()
+        if name == "WeightsMessage":
+            return WeightsMessage(vector_clock=clock_or_label,
+                                  key_range=KeyRange(start, end),
+                                  values=values)
+        return GradientMessage(vector_clock=clock_or_label,
+                               key_range=KeyRange(start, end),
+                               values=values, worker_id=worker)
+    if name == "LabeledData":
+        (n,) = struct.unpack_from("<q", payload, off)
+        off += 8
+        keys = np.frombuffer(payload, dtype="<i4", offset=off, count=n)
+        off += 4 * n
+        vals = np.frombuffer(payload, dtype="<f4", offset=off, count=n)
+        return LabeledData(
+            features={int(k): float(v) for k, v in zip(keys, vals)},
+            label=clock_or_label)
+    raise ValueError(f"unknown binary type id {tid}")

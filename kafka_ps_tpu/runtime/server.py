@@ -61,6 +61,9 @@ class ServerNode:
         self.iterations = 0          # total gradient messages applied
         self.last_metrics = None
         self._loop_started = False   # bootstrap broadcast done once
+        # monotonic stamp of the last weights send per worker (heartbeat
+        # baseline for the supervisor, runtime/app.py)
+        self.weights_sent_at = [time.monotonic()] * cfg.num_workers
         # optional periodic checkpointing (utils/checkpoint.py)
         self.checkpoint_path: str | None = None
         self.checkpoint_every: int = 50   # <= 0: only save on exit
@@ -89,15 +92,14 @@ class ServerNode:
             if status.active and status.weights_message_sent:
                 self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
                                  self._weights_message(status.vector_clock))
+                self.weights_sent_at[worker] = time.monotonic()
         delay = self.cfg.max_vector_clock_delay
         if delay == EVENTUAL:
             # eventual answers immediately, so any surviving pending
             # reply is re-issued at once
             for worker, s in enumerate(self.tracker.tracker):
                 if s.active and not s.weights_message_sent:
-                    self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
-                                     self._weights_message(s.vector_clock))
-                    self.tracker.sent_message(worker, s.vector_clock)
+                    self.send_weights(worker, s.vector_clock)
         else:
             # sequential == bounded with delay 0: the tracker's own
             # sendable predicate (MessageTracker.java:69-79)
@@ -108,6 +110,16 @@ class ServerNode:
             vector_clock=vector_clock,
             key_range=KeyRange(0, self.cfg.model.num_params),
             values=self.theta.copy())
+
+    def send_weights(self, worker: int, clock: int) -> None:
+        """The single weights-send site: dispatch + tracker bookkeeping +
+        the sent-at stamp the supervisor's heartbeat measures from (time
+        a worker spends gate-blocked and idle must not count against
+        it)."""
+        self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
+                         self._weights_message(clock))
+        self.weights_sent_at[worker] = time.monotonic()
+        self.tracker.sent_message(worker, clock)
 
     # -- consistency gate (ServerProcessor.java:95-134) --------------------
 
@@ -146,9 +158,7 @@ class ServerNode:
         self.fabric.purge(fabric_mod.WEIGHTS_TOPIC, worker, lambda m: True)
         clock = self.tracker.reactivate_worker(worker)
         self.tracer.count("server.workers_readmitted")
-        self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
-                         self._weights_message(clock))
-        self.tracker.sent_message(worker, clock)
+        self.send_weights(worker, clock)
         return clock
 
     def _flush_gate(self) -> None:
@@ -159,9 +169,7 @@ class ServerNode:
             return
         for worker, clock in self.tracker.get_all_sendable_messages(
                 max(delay, 0)):
-            self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
-                             self._weights_message(clock))
-            self.tracker.sent_message(worker, clock)
+            self.send_weights(worker, clock)
 
     # -- the hot path (ServerProcessor.java:143-183) -----------------------
 
@@ -195,9 +203,7 @@ class ServerNode:
 
         for worker, clock in self.workers_to_respond_to(msg.vector_clock,
                                                         msg.worker_id):
-            self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
-                             self._weights_message(clock))
-            self.tracker.sent_message(worker, clock)
+            self.send_weights(worker, clock)
 
         self.maybe_checkpoint()
 

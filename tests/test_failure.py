@@ -167,6 +167,25 @@ def test_checkpoint_roundtrips_active_flags(tmp_path):
     assert 1 not in app2.server.tracker.active_workers
 
 
+def test_fused_bsp_respects_evictions():
+    app = _make_app(num_workers=3)
+    app.server.remove_worker(1)
+    clocks_before = list(app.server.tracker.clocks)
+    app.run_fused_bsp(max_server_iterations=4)
+    # only the two active workers advanced; the evicted slot is frozen
+    assert app.server.tracker.clocks[1] == clocks_before[1]
+    assert app.server.tracker.clocks[0] > clocks_before[0]
+    assert app.workers[1].iterations == 0
+    assert app.server.iterations >= 4
+
+
+def test_wait_for_prefill_skips_evicted_workers():
+    app = _make_app(num_workers=2)
+    app.server.remove_worker(1)
+    # worker 1's buffer would never fill (rerouted); must not block
+    app.wait_for_prefill(min_per_worker=1, timeout=1.0)
+
+
 # -- threaded runtime with fault injection ---------------------------------
 
 class _CrashAfter:
