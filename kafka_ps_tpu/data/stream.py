@@ -24,10 +24,38 @@ Sink = Callable[[int, dict[int, float], int], None]  # (worker, features, label)
 
 
 def iter_csv_rows(csv_path: str, has_header: bool = True,
-                  num_features: int | None = None
+                  num_features: int | None = None,
+                  use_native: bool | None = None
                   ) -> Iterator[tuple[dict[int, float], int]]:
     """Yield (sparse_features, label) per CSV row, dropping zero features
-    (CsvProducer.java:52-58)."""
+    (CsvProducer.java:52-58).
+
+    `use_native`: True forces the C++ parser (kafka_ps_tpu.native),
+    False forces pure Python, None (default) auto-selects — the native
+    path parses the whole file in one pass and replays rows; the Python
+    path streams line by line."""
+    if use_native is not False:
+        from kafka_ps_tpu import native
+        parsed = None
+        if native.is_available():
+            try:
+                parsed = native.parse_csv(csv_path, has_header=has_header)
+            except RuntimeError:
+                # the C parser is stricter (uniform width, no stray
+                # whitespace); on auto-select fall through to Python
+                if use_native:
+                    raise
+        elif use_native:
+            raise RuntimeError("native CSV parser requested but unavailable")
+        if parsed is not None:
+            if (num_features is not None and parsed.num_rows > 0
+                    and parsed.num_features != num_features):
+                raise ValueError(
+                    f"rows have {parsed.num_features + 1} columns, "
+                    f"expected {num_features + 1}")
+            for i in range(parsed.num_rows):
+                yield parsed.row(i)
+            return
     with open(csv_path) as f:
         if has_header:
             f.readline()
@@ -52,6 +80,7 @@ class CsvStreamProducer:
                  prefill_per_worker: int = 128,
                  has_header: bool = True,
                  num_features: int | None = None,
+                 use_native: bool | None = None,
                  sleep: Callable[[float], None] = time.sleep):
         self.csv_path = csv_path
         self.num_workers = num_workers
@@ -60,6 +89,10 @@ class CsvStreamProducer:
         self.prefill_per_worker = prefill_per_worker
         self.has_header = has_header
         self.num_features = num_features
+        # None = auto (native one-pass parse when available — O(file)
+        # memory, faster); False = force the lazy line-by-line Python
+        # path (constant memory, first row immediately)
+        self.use_native = use_native
         self._sleep = sleep
         self.rows_sent = 0
         self.finished = threading.Event()
@@ -67,14 +100,18 @@ class CsvStreamProducer:
     def run(self) -> None:
         prefill = self.num_workers * self.prefill_per_worker
         # 1 s sleep every this many rows (CsvProducer.java:75-78); a
-        # time_per_event above 1000 ms degenerates to sleeping every row.
-        rows_per_sleep = max(1, int(1000 / self.time_per_event_ms))
+        # time_per_event above 1000 ms degenerates to sleeping every row;
+        # <= 0 means unthrottled (no pacing at all).
+        rows_per_sleep = (max(1, int(1000 / self.time_per_event_ms))
+                          if self.time_per_event_ms > 0 else 0)
         for feats, label in iter_csv_rows(self.csv_path, self.has_header,
-                                          self.num_features):
+                                          self.num_features,
+                                          use_native=self.use_native):
             worker = self.rows_sent % self.num_workers
             self.sink(worker, feats, label)
             self.rows_sent += 1
-            if self.rows_sent >= prefill and self.rows_sent % rows_per_sleep == 0:
+            if (rows_per_sleep and self.rows_sent >= prefill
+                    and self.rows_sent % rows_per_sleep == 0):
                 self._sleep(1.0)
         self.finished.set()
 
