@@ -88,10 +88,19 @@ def grad_loss(theta: jax.Array, x: jax.Array, y: jax.Array, mask: jax.Array,
     which would silently turn a per-worker gradient into the global sum
     (see tests/test_parallel.py::test_explicit_grad_matches_autodiff).
     """
+    onehot = jax.nn.one_hot(y, cfg.num_rows, dtype=jnp.float32)
+    return grad_loss_onehot(theta, x, onehot, mask, cfg)
+
+
+def grad_loss_onehot(theta: jax.Array, x: jax.Array, onehot: jax.Array,
+                     mask: jax.Array, cfg: ModelConfig
+                     ) -> tuple[jax.Array, jax.Array]:
+    """grad_loss with the label one-hot precomputed — callers running
+    many solver steps on a fixed batch (lax.scan in local_update and the
+    fused multi-round BSP step) hoist the one-hot out of the loop."""
     params = unflatten(theta, cfg)
     lg = logits(params, x)
     logp = jax.nn.log_softmax(lg, axis=-1)
-    onehot = jax.nn.one_hot(y, cfg.num_rows, dtype=lg.dtype)
     denom = jnp.maximum(mask.sum(), 1.0)
     nll = -(logp * onehot).sum(axis=-1)
     loss = (nll * mask).sum() / denom
@@ -114,14 +123,24 @@ def local_update(theta: jax.Array, x: jax.Array, y: jax.Array, mask: jax.Array,
     ("k local solver steps, delta exchanged") is what is matched, not
     Spark's line-search trajectory (documented divergence, SURVEY §7).
     """
+    onehot = jax.nn.one_hot(y, cfg.num_rows, dtype=jnp.float32)
+    return local_update_onehot(theta, x, onehot, mask, cfg=cfg)
+
+
+def local_update_onehot(theta: jax.Array, x: jax.Array, onehot: jax.Array,
+                        mask: jax.Array, *, cfg: ModelConfig
+                        ) -> tuple[jax.Array, jax.Array]:
+    """local_update with the one-hot precomputed by the caller — the
+    fused multi-round BSP step hoists it above its rounds-scan (the
+    labels never change between rounds)."""
     lr = cfg.local_learning_rate
 
     def step(t, _):
-        g, _ = grad_loss(t, x, y, mask, cfg)
+        g, _ = grad_loss_onehot(t, x, onehot, mask, cfg)
         return t - lr * g, None
 
     theta_new, _ = jax.lax.scan(step, theta, None, length=cfg.num_max_iter)
-    _, final_loss = grad_loss(theta_new, x, y, mask, cfg)
+    _, final_loss = grad_loss_onehot(theta_new, x, onehot, mask, cfg)
     return theta_new - theta, final_loss
 
 

@@ -44,6 +44,13 @@ def _vmapped_local_updates(theta, x, y, mask, cfg: ModelConfig):
     )(x, y, mask)
 
 
+def _vmapped_local_updates_onehot(theta, x, onehot, mask, cfg: ModelConfig):
+    return jax.vmap(
+        lambda xx, oo, mm: logreg.local_update_onehot(theta, xx, oo, mm,
+                                                      cfg=cfg)
+    )(x, onehot, mask)
+
+
 def make_bsp_step(cfg: ModelConfig, num_workers: int, server_lr: float,
                   mesh: Mesh | None = None) -> BspStep:
     """Build the fused one-iteration BSP step.
@@ -95,11 +102,12 @@ def make_bsp_multi_step(cfg: ModelConfig, num_workers: int, server_lr: float,
     arrivals the reference's loop re-trains on the same buffer
     (WorkerTrainingProcessor.java:63-97), which is exactly a scan."""
 
-    def round_body(theta, x, y, mask, psum_axis: bool):
+    def round_body(theta, x, onehot, mask, psum_axis: bool):
         # The scan carry stays axis-invariant: pvary a per-round copy for
         # the device-local math, psum the delta back to invariance.
         theta_local = jax.lax.pvary(theta, WORKER_AXIS) if psum_axis else theta
-        deltas, losses = _vmapped_local_updates(theta_local, x, y, mask, cfg)
+        deltas, losses = _vmapped_local_updates_onehot(
+            theta_local, x, onehot, mask, cfg)
         delta_sum, loss_sum = deltas.sum(0), losses.sum()
         if psum_axis:
             delta_sum = jax.lax.psum(delta_sum, WORKER_AXIS)
@@ -107,8 +115,11 @@ def make_bsp_multi_step(cfg: ModelConfig, num_workers: int, server_lr: float,
         return theta + server_lr * delta_sum, loss_sum / num_workers
 
     def scanned(theta, x, y, mask, psum_axis):
+        # labels are fixed across rounds: one-hot once, above the scan
+        onehot = jax.nn.one_hot(y, cfg.num_rows, dtype=jnp.float32)
+
         def body(t, _):
-            t2, loss = round_body(t, x, y, mask, psum_axis)
+            t2, loss = round_body(t, x, onehot, mask, psum_axis)
             return t2, loss
         return jax.lax.scan(body, theta, None, length=rounds)
 
