@@ -55,6 +55,11 @@ def build_parser(include_server_flags: bool = True,
                         "BaseKafkaApp.java:25)")
     p.add_argument("--num_features", type=int, default=1024)
     p.add_argument("--num_classes", type=int, default=5)
+    p.add_argument("--task", choices=["logreg", "mlp"], default="logreg",
+                   help="model family (models/task.py registry); logreg "
+                        "is the reference's task")
+    p.add_argument("--hidden_dim", type=int, default=128,
+                   help="hidden width of the mlp task")
     p.add_argument("--local_iterations", type=int, default=2,
                    help="k local solver steps per iteration "
                         "(numMaxIter, LogisticRegressionTaskSpark.java:35)")
@@ -116,10 +121,12 @@ def make_app_from_args(args, resuming: bool = False):
     cfg = PSConfig(
         num_workers=args.num_workers,
         consistency_model=args.consistency_model,
+        task=args.task,
         model=ModelConfig(num_features=args.num_features,
                           num_classes=args.num_classes,
                           num_max_iter=args.local_iterations,
-                          local_learning_rate=args.local_learning_rate),
+                          local_learning_rate=args.local_learning_rate,
+                          hidden_dim=args.hidden_dim),
         buffer=BufferConfig(min_size=args.min_buffer_size,
                             max_size=args.max_buffer_size,
                             coefficient=args.buffer_size_coefficient),
@@ -153,6 +160,10 @@ def run_with_args(args) -> int:
             "--pallas applies to the per-node worker path only; the "
             "--fused BSP path runs its own shard_map program "
             "(parallel/bsp.py) — drop one of the two flags")
+    if args.pallas and args.task != "logreg":
+        raise SystemExit(
+            "--pallas implements the logreg local update only "
+            "(ops/fused_update.py); drop --pallas or use --task logreg")
     if args.verbose:
         print("\nUsed parameter:")
         for k, v in sorted(vars(args).items()):

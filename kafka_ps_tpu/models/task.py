@@ -1,0 +1,95 @@
+"""The ML-task abstraction — the reference's implicit task API made
+explicit.
+
+The reference's whole learning surface is one class,
+`LogisticRegressionTaskSpark` (ml/LogisticRegressionTaskSpark.java:30):
+`initialize` / `setWeights` / `calculateGradients` / `calculateTestMetrics`
+over a flat integer-keyed parameter vector.  The processors only ever
+touch that surface, so the PS runtime is model-agnostic in spirit —
+this module makes it so in fact.  A task owns:
+
+  * the flat parameter layout (`num_params` — the KeyRange key space),
+  * the k-step local solver (`local_update` → delta, the "gradient"
+    the reference exchanges, LogisticRegressionTaskSpark.java:179-220),
+  * test evaluation (`evaluate` → weighted F1 / accuracy / loss,
+    Metrics.java:15-24).
+
+Every entry point (runtime worker, fused BSP step, range-sharded step,
+server eval) dispatches through a task; `logreg` stays the default —
+the reference's model — and `mlp` is a second family proving the
+runtime generalizes.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+import jax
+
+from kafka_ps_tpu.models import logreg
+from kafka_ps_tpu.models import metrics as metrics_mod
+from kafka_ps_tpu.utils.config import ModelConfig
+
+
+class MLTask(Protocol):
+    """What the PS runtime needs from a model family.  All functions are
+    jit-safe and shard_map-safe (no data-dependent Python control flow;
+    gradients must not rely on AD of replicated operands — see
+    logreg.grad_loss's note on shard_map cotangent psums)."""
+
+    cfg: ModelConfig
+
+    @property
+    def num_params(self) -> int: ...
+
+    def init_params(self) -> jax.Array: ...
+
+    def local_update(self, theta, x, y, mask): ...
+
+    def local_update_onehot(self, theta, x, onehot, mask): ...
+
+    def evaluate(self, theta, x_test, y_test) -> metrics_mod.Metrics: ...
+
+
+class LogRegTask:
+    """The reference's model: multinomial LR over the flat
+    (C+1)·F + (C+1) layout (models/logreg.py)."""
+
+    def __init__(self, cfg: ModelConfig):
+        self.cfg = cfg
+
+    @property
+    def num_params(self) -> int:
+        return self.cfg.num_params
+
+    def init_params(self):
+        return logreg.init_params(self.cfg).flat
+
+    def local_update(self, theta, x, y, mask):
+        return logreg.local_update(theta, x, y, mask, cfg=self.cfg)
+
+    def local_update_onehot(self, theta, x, onehot, mask):
+        return logreg.local_update_onehot(theta, x, onehot, mask,
+                                          cfg=self.cfg)
+
+    def evaluate(self, theta, x_test, y_test) -> metrics_mod.Metrics:
+        return metrics_mod.evaluate(theta, x_test, y_test, cfg=self.cfg)
+
+
+_REGISTRY = {"logreg": LogRegTask}
+
+
+def register(name: str, factory) -> None:
+    _REGISTRY[name] = factory
+
+
+def get_task(name: str, cfg: ModelConfig) -> MLTask:
+    if name not in _REGISTRY:
+        # late-bind optional families so importing task.py stays cheap
+        if name == "mlp":
+            from kafka_ps_tpu.models.mlp import MLPTask
+            register("mlp", MLPTask)
+        else:
+            raise ValueError(
+                f"unknown task {name!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](cfg)

@@ -29,7 +29,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kafka_ps_tpu.models import logreg
 from kafka_ps_tpu.parallel.mesh import WORKER_AXIS
 from kafka_ps_tpu.utils.config import ModelConfig
 
@@ -38,21 +37,25 @@ from kafka_ps_tpu.utils.config import ModelConfig
 BspStep = Callable[..., tuple[jax.Array, jax.Array]]
 
 
-def _vmapped_local_updates(theta, x, y, mask, cfg: ModelConfig):
+def _default_task(cfg: ModelConfig):
+    from kafka_ps_tpu.models.task import get_task
+    return get_task("logreg", cfg)
+
+
+def _vmapped_local_updates(theta, x, y, mask, task):
     return jax.vmap(
-        lambda xx, yy, mm: logreg.local_update(theta, xx, yy, mm, cfg=cfg)
+        lambda xx, yy, mm: task.local_update(theta, xx, yy, mm)
     )(x, y, mask)
 
 
-def _vmapped_local_updates_onehot(theta, x, onehot, mask, cfg: ModelConfig):
+def _vmapped_local_updates_onehot(theta, x, onehot, mask, task):
     return jax.vmap(
-        lambda xx, oo, mm: logreg.local_update_onehot(theta, xx, oo, mm,
-                                                      cfg=cfg)
+        lambda xx, oo, mm: task.local_update_onehot(theta, xx, oo, mm)
     )(x, onehot, mask)
 
 
 def make_bsp_step(cfg: ModelConfig, num_workers: int, server_lr: float,
-                  mesh: Mesh | None = None) -> BspStep:
+                  mesh: Mesh | None = None, task=None) -> BspStep:
     """Build the fused one-iteration BSP step.
 
     With a mesh: `shard_map` over the worker axis, one (or more) logical
@@ -60,13 +63,15 @@ def make_bsp_step(cfg: ModelConfig, num_workers: int, server_lr: float,
     the default device.
     """
 
+    task = task or _default_task(cfg)
+
     def apply(theta, delta_sum, loss_sum):
         return theta + server_lr * delta_sum, loss_sum / num_workers
 
     if mesh is None:
         @jax.jit
         def step(theta, x, y, mask):
-            deltas, losses = _vmapped_local_updates(theta, x, y, mask, cfg)
+            deltas, losses = _vmapped_local_updates(theta, x, y, mask, task)
             return apply(theta, deltas.sum(0), losses.sum())
 
         return step
@@ -81,7 +86,7 @@ def make_bsp_step(cfg: ModelConfig, num_workers: int, server_lr: float,
         # device-varying so the scan carry inside local_update has a
         # stable varying-axes type (psum below restores invariance).
         theta_v = jax.lax.pvary(theta, WORKER_AXIS)
-        deltas, losses = _vmapped_local_updates(theta_v, x, y, mask, cfg)
+        deltas, losses = _vmapped_local_updates(theta_v, x, y, mask, task)
         delta_sum = jax.lax.psum(deltas.sum(0), WORKER_AXIS)
         loss_sum = jax.lax.psum(losses.sum(), WORKER_AXIS)
         return apply(theta, delta_sum, loss_sum)
@@ -94,7 +99,8 @@ def make_bsp_step(cfg: ModelConfig, num_workers: int, server_lr: float,
 
 
 def make_bsp_multi_step(cfg: ModelConfig, num_workers: int, server_lr: float,
-                        rounds: int, mesh: Mesh | None = None) -> BspStep:
+                        rounds: int, mesh: Mesh | None = None,
+                        task=None) -> BspStep:
     """`rounds` BSP iterations as ONE device program (lax.scan over the
     fused step) — a single dispatch executes an entire training stretch,
     eliminating per-iteration host latency entirely.  This is the
@@ -102,12 +108,14 @@ def make_bsp_multi_step(cfg: ModelConfig, num_workers: int, server_lr: float,
     arrivals the reference's loop re-trains on the same buffer
     (WorkerTrainingProcessor.java:63-97), which is exactly a scan."""
 
+    task = task or _default_task(cfg)
+
     def round_body(theta, x, onehot, mask, psum_axis: bool):
         # The scan carry stays axis-invariant: pvary a per-round copy for
         # the device-local math, psum the delta back to invariance.
         theta_local = jax.lax.pvary(theta, WORKER_AXIS) if psum_axis else theta
         deltas, losses = _vmapped_local_updates_onehot(
-            theta_local, x, onehot, mask, cfg)
+            theta_local, x, onehot, mask, task)
         delta_sum, loss_sum = deltas.sum(0), losses.sum()
         if psum_axis:
             delta_sum = jax.lax.psum(delta_sum, WORKER_AXIS)

@@ -33,29 +33,31 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kafka_ps_tpu.models import logreg
 from kafka_ps_tpu.parallel.mesh import PARAM_AXIS, WORKER_AXIS
 from kafka_ps_tpu.utils.config import ModelConfig
 
 
-def padded_num_params(cfg: ModelConfig, num_param_shards: int) -> int:
+def padded_num_params(layout, num_param_shards: int) -> int:
     """theta length padded so every param shard is equal-size (static
-    shapes; the pad keys are dead weight ignored by unflatten)."""
-    p = cfg.num_params
+    shapes; the pad keys are dead weight ignored by unflatten).
+
+    `layout` is anything exposing `.num_params` — a ModelConfig (the
+    logreg flat layout) or an MLTask (models/task.py)."""
+    p = layout.num_params
     return p + (-p) % num_param_shards
 
 
-def pad_theta(theta, cfg: ModelConfig, num_param_shards: int):
+def pad_theta(theta, layout, num_param_shards: int):
     return jnp.pad(jnp.asarray(theta),
-                   (0, padded_num_params(cfg, num_param_shards)
-                    - cfg.num_params))
+                   (0, padded_num_params(layout, num_param_shards)
+                    - layout.num_params))
 
 
-def shard_theta(mesh: Mesh, theta, cfg: ModelConfig):
+def shard_theta(mesh: Mesh, theta, layout):
     """Place the (padded) parameter vector range-sharded over the params
     axis, replicated over the workers axis."""
     num_param_shards = mesh.shape[PARAM_AXIS]
-    return jax.device_put(pad_theta(theta, cfg, num_param_shards),
+    return jax.device_put(pad_theta(theta, layout, num_param_shards),
                           NamedSharding(mesh, P(PARAM_AXIS)))
 
 
@@ -73,7 +75,7 @@ RangeShardedStep = Callable[..., tuple[jax.Array, jax.Array]]
 
 def make_range_sharded_step(cfg: ModelConfig, num_workers: int,
                             server_lr: float, mesh: Mesh,
-                            rounds: int = 1) -> RangeShardedStep:
+                            rounds: int = 1, task=None) -> RangeShardedStep:
     """Fused BSP step(s) with range-sharded parameters on a 2-D
     (workers × params) mesh.  `rounds > 1` scans whole iterations into
     one device program, like bsp.make_bsp_multi_step."""
@@ -86,14 +88,17 @@ def make_range_sharded_step(cfg: ModelConfig, num_workers: int,
         raise ValueError(
             f"num_workers {num_workers} must be a multiple of the mesh "
             f"size {num_devices} (workers are sharded over both axes)")
+    if task is None:
+        from kafka_ps_tpu.models.task import get_task
+        task = get_task("logreg", cfg)
+    n_real = task.num_params
     param_shards = mesh.shape[PARAM_AXIS]
-    n_pad = padded_num_params(cfg, param_shards)
+    n_pad = padded_num_params(task, param_shards)
     shard_len = n_pad // param_shards
 
     def local_update_padded(theta_full, xx, yy, mm):
-        delta, loss = logreg.local_update(theta_full[:cfg.num_params],
-                                          xx, yy, mm, cfg=cfg)
-        return jnp.pad(delta, (0, n_pad - cfg.num_params)), loss
+        delta, loss = task.local_update(theta_full[:n_real], xx, yy, mm)
+        return jnp.pad(delta, (0, n_pad - n_real)), loss
 
     def round_body(theta_shard, x, y, mask):
         # weights pull: reassemble the full replica from the server shards
@@ -129,6 +134,7 @@ def make_range_sharded_step(cfg: ModelConfig, num_workers: int,
     return jax.jit(sharded)
 
 
-def unshard_theta(theta_padded, cfg: ModelConfig) -> np.ndarray:
-    """Back to the host-side flat layout (drops the shard padding)."""
-    return np.asarray(theta_padded)[:cfg.num_params]
+def unshard_theta(theta_padded, layout) -> np.ndarray:
+    """Back to the host-side flat layout (drops the shard padding).
+    `layout` as in padded_num_params."""
+    return np.asarray(theta_padded)[:layout.num_params]

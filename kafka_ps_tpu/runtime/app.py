@@ -253,7 +253,8 @@ class StreamingPSApp:
         # the data reroute and their tracker slots must stay frozen)
         active = self.server.tracker.active_workers
         step = bsp.make_bsp_step(self.cfg.model, len(active),
-                                 self.cfg.server_lr, mesh=mesh)
+                                 self.cfg.server_lr, mesh=mesh,
+                                 task=self.server.task)
         theta = jnp.asarray(self.server.theta)
         # under BSP all active clocks are uniform; resume from the
         # restored one
@@ -280,17 +281,17 @@ class StreamingPSApp:
             self.tracer.count("bsp.steps")
             clock += 1
             self.server.iterations += len(active)
-            self.server.theta = np.asarray(theta)
+            # np.array (copy): an asarray view of a JAX array is
+            # read-only and the message path mutates theta in place
+            self.server.theta = np.array(theta)
             for w in active:
                 self.workers[w].iterations += 1
                 self.server.tracker.tracker[w].vector_clock = clock
                 self.server.tracker.tracker[w].weights_message_sent = True
             self.server.maybe_checkpoint()
             if log_metrics and self.server.test_x is not None:
-                from kafka_ps_tpu.models import metrics as metrics_mod
-                m = metrics_mod.evaluate(theta, self.server.test_x,
-                                         self.server.test_y,
-                                         cfg=self.cfg.model)
+                m = self.server.task.evaluate(theta, self.server.test_x,
+                                              self.server.test_y)
                 self.server.last_metrics = m
                 now = int(time.time() * 1000)
                 self.server.log(

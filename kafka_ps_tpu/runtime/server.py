@@ -54,7 +54,11 @@ class ServerNode:
         self.cfg = cfg
         self.fabric = fabric
         self.tracker = MessageTracker(cfg.num_workers)
-        self.theta = np.zeros((cfg.model.num_params,), dtype=np.float32)
+        from kafka_ps_tpu.models.task import get_task
+        self.task = get_task(cfg.task, cfg.model)
+        # np.array (not asarray): a JAX array view is read-only and the
+        # server mutates theta in place
+        self.theta = np.array(self.task.init_params(), dtype=np.float32)
         self.test_x = jnp.asarray(test_x) if test_x is not None else None
         self.test_y = jnp.asarray(test_y) if test_y is not None else None
         self.log = log or (lambda line: None)
@@ -108,7 +112,7 @@ class ServerNode:
     def _weights_message(self, vector_clock: int) -> WeightsMessage:
         return WeightsMessage(
             vector_clock=vector_clock,
-            key_range=KeyRange(0, self.cfg.model.num_params),
+            key_range=KeyRange(0, self.task.num_params),
             values=self.theta.copy())
 
     def send_weights(self, worker: int, clock: int) -> None:
@@ -191,8 +195,8 @@ class ServerNode:
         if (msg.worker_id == 0 and self.test_x is not None
                 and msg.vector_clock % self.cfg.eval_every == 0):
             with self.tracer.span("server.eval", clock=msg.vector_clock):
-                m = metrics_mod.evaluate(jnp.asarray(self.theta), self.test_x,
-                                         self.test_y, cfg=self.cfg.model)
+                m = self.task.evaluate(jnp.asarray(self.theta), self.test_x,
+                                       self.test_y)
                 m = metrics_mod.Metrics(*map(float, m))
             self.last_metrics = m
             # schema: timestamp;partition;vectorClock;loss;fMeasure;accuracy

@@ -20,8 +20,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from kafka_ps_tpu.data.buffer import SlidingBuffer
-from kafka_ps_tpu.models import logreg
-from kafka_ps_tpu.models import metrics as metrics_mod
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime.messages import GradientMessage, KeyRange, WeightsMessage
 from kafka_ps_tpu.utils.config import PSConfig
@@ -45,7 +43,9 @@ class WorkerNode:
         self.cfg = cfg
         self.fabric = fabric
         self.buffer = buffer
-        self.theta = np.zeros((cfg.model.num_params,), dtype=np.float32)
+        from kafka_ps_tpu.models.task import get_task
+        self.task = get_task(cfg.task, cfg.model)
+        self.theta = np.zeros((self.task.num_params,), dtype=np.float32)
         self.test_x = jnp.asarray(test_x) if test_x is not None else None
         self.test_y = jnp.asarray(test_y) if test_y is not None else None
         self.log = log or (lambda line: None)
@@ -69,25 +69,27 @@ class WorkerNode:
             raise RuntimeError(
                 f"There is no data in the buffer of worker {self.worker_id}")
 
-        if self.cfg.use_pallas:
+        if self.cfg.use_pallas and self.cfg.task == "logreg":
             from kafka_ps_tpu.ops import fused_update
-            update_fn = fused_update.local_update
+
+            def update_fn(theta, xx, yy, mm):
+                return fused_update.local_update(theta, xx, yy, mm,
+                                                 cfg=self.cfg.model)
         else:
-            update_fn = logreg.local_update
+            update_fn = self.task.local_update
         with self.tracer.span("worker.local_update", worker=self.worker_id,
                               clock=msg.vector_clock):
             delta, loss = update_fn(
                 jnp.asarray(self.theta), jnp.asarray(x), jnp.asarray(y),
-                jnp.asarray(mask), cfg=self.cfg.model)
+                jnp.asarray(mask))
             delta = np.asarray(delta)
 
         # Post-fit test metrics, like the reference's per-iteration eval
         # inside calculateGradients (LogisticRegressionTaskSpark.java:186).
         f1, acc = -1.0, -1.0
         if self.test_x is not None:
-            m = metrics_mod.evaluate(jnp.asarray(self.theta + delta),
-                                     self.test_x, self.test_y,
-                                     cfg=self.cfg.model)
+            m = self.task.evaluate(jnp.asarray(self.theta + delta),
+                                   self.test_x, self.test_y)
             f1, acc = float(m.f1), float(m.accuracy)
 
         # schema: timestamp;partition;vectorClock;loss;fMeasure;accuracy;
@@ -102,7 +104,7 @@ class WorkerNode:
             fabric_mod.GRADIENTS_TOPIC, 0,
             GradientMessage(
                 vector_clock=msg.vector_clock,
-                key_range=KeyRange(0, self.cfg.model.num_params),
+                key_range=KeyRange(0, self.task.num_params),
                 values=delta,
                 worker_id=self.worker_id))
         self.last_progress = time.monotonic()

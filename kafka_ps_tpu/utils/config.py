@@ -33,6 +33,7 @@ class ModelConfig:
     num_classes: int = 5
     num_max_iter: int = 2       # k local solver steps per iteration
     local_learning_rate: float = 0.5  # step size of the local k-step solver
+    hidden_dim: int = 128       # used by the mlp task family only
 
     @property
     def num_rows(self) -> int:
@@ -69,6 +70,9 @@ class PSConfig:
 
     num_workers: int = 4
     consistency_model: int = SEQUENTIAL   # -c: 0 BSP, k>0 SSP, -1 ASP
+    # model family (models/task.py registry): "logreg" (the reference's
+    # task) or "mlp"
+    task: str = "logreg"
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     buffer: BufferConfig = dataclasses.field(default_factory=BufferConfig)
     stream: StreamConfig = dataclasses.field(default_factory=StreamConfig)
