@@ -44,8 +44,11 @@ def build_parser(include_server_flags: bool = True,
     p.add_argument("-v", "--verbose", action="store_true",
                    help="print the parameters that are used")
     p.add_argument("-r", "--remote", action="store_true",
-                   help="reference: remote Kafka broker; here: reserved "
-                        "for multi-host (DCN) deployment")
+                   help="distributed mode: join the multi-host job "
+                        "(parallel/multihost.py; KPS_* env vars) and run "
+                        "the fused BSP step over the global device mesh — "
+                        "the reference's remote-Kafka-broker role "
+                        "(ServerAppRunner.java:63)")
     p.add_argument("-l", "--logging", action="store_true",
                    help="write performance logs to ./logs-server.csv / "
                         "./logs-worker.csv instead of stdout")
@@ -164,6 +167,18 @@ def run_with_args(args) -> int:
         raise SystemExit(
             "--pallas implements the logreg local update only "
             "(ops/fused_update.py); drop --pallas or use --task logreg")
+    if args.remote and not args.fused:
+        from kafka_ps_tpu.parallel import multihost
+        if multihost.initialize():
+            # joined a real multi-process job: only the fused BSP step
+            # runs over the global mesh; the host-orchestrated modes are
+            # single-host by design (deploy/README.md)
+            raise SystemExit(
+                "-r joined a multi-host job but only --fused runs over "
+                "the global mesh; add --fused (or run the async "
+                "consistency modes single-host)")
+        # unconfigured: behave like the reference's remote flag on a
+        # local run — nothing to switch (ServerAppRunner.java:63)
     if args.verbose:
         print("\nUsed parameter:")
         for k, v in sorted(vars(args).items()):
@@ -191,7 +206,19 @@ def run_with_args(args) -> int:
     try:
         with device_trace(args.device_trace):
             if args.fused:
-                app.run_fused_bsp(max_server_iterations=max_iters)
+                mesh = None
+                if args.remote:
+                    from kafka_ps_tpu.parallel import multihost
+                    multihost.initialize()
+                    mesh = multihost.global_worker_mesh()
+                    n_active = len(app.server.tracker.active_workers)
+                    if n_active % mesh.devices.size != 0:
+                        raise SystemExit(
+                            f"{n_active} active workers must be a "
+                            f"multiple of the {mesh.devices.size}-device "
+                            f"mesh in --remote mode")
+                app.run_fused_bsp(max_server_iterations=max_iters,
+                                  mesh=mesh)
             elif args.mode == "serial":
                 app.run_serial(max_server_iterations=max_iters,
                                pump=lambda: None)

@@ -97,17 +97,7 @@ class MLPTask:
             w2=w2, b2=jnp.zeros(cfg.num_rows)))
 
     def local_update_onehot(self, theta, x, onehot, mask):
-        cfg = self.cfg
-        lr = cfg.local_learning_rate
-        grad = jax.grad(_loss_onehot)
-
-        def step(t, _):
-            return t - lr * grad(t, x, onehot, mask, cfg), None
-
-        theta_new, _ = jax.lax.scan(step, theta, None,
-                                    length=cfg.num_max_iter)
-        final_loss = _loss_onehot(theta_new, x, onehot, mask, cfg)
-        return theta_new - theta, final_loss
+        return _local_update_onehot(theta, x, onehot, mask, cfg=self.cfg)
 
     def local_update(self, theta, x, y, mask):
         onehot = jax.nn.one_hot(y, self.cfg.num_rows, dtype=jnp.float32)
@@ -115,6 +105,22 @@ class MLPTask:
 
     def evaluate(self, theta, x_test, y_test) -> metrics_mod.Metrics:
         return _evaluate(theta, x_test, y_test, cfg=self.cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _local_update_onehot(theta, x, onehot, mask, *, cfg: ModelConfig):
+    """Jitted like logreg.local_update so the per-node worker hot path
+    runs one cached XLA program per iteration (re-jitting inside an
+    enclosing jit — the fused BSP steps — is free: it inlines)."""
+    lr = cfg.local_learning_rate
+    grad = jax.grad(_loss_onehot)
+
+    def step(t, _):
+        return t - lr * grad(t, x, onehot, mask, cfg), None
+
+    theta_new, _ = jax.lax.scan(step, theta, None, length=cfg.num_max_iter)
+    final_loss = _loss_onehot(theta_new, x, onehot, mask, cfg)
+    return theta_new - theta, final_loss
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))

@@ -79,6 +79,12 @@ class LogRegTask:
 _REGISTRY = {"logreg": LogRegTask}
 
 
+def default_task(cfg: ModelConfig) -> "MLTask":
+    """The reference's model family — what every factory falls back to
+    when no task is passed."""
+    return get_task("logreg", cfg)
+
+
 def register(name: str, factory) -> None:
     _REGISTRY[name] = factory
 

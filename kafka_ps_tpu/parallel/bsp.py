@@ -38,8 +38,8 @@ BspStep = Callable[..., tuple[jax.Array, jax.Array]]
 
 
 def _default_task(cfg: ModelConfig):
-    from kafka_ps_tpu.models.task import get_task
-    return get_task("logreg", cfg)
+    from kafka_ps_tpu.models.task import default_task
+    return default_task(cfg)
 
 
 def _vmapped_local_updates(theta, x, y, mask, task):

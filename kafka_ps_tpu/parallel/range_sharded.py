@@ -89,8 +89,8 @@ def make_range_sharded_step(cfg: ModelConfig, num_workers: int,
             f"num_workers {num_workers} must be a multiple of the mesh "
             f"size {num_devices} (workers are sharded over both axes)")
     if task is None:
-        from kafka_ps_tpu.models.task import get_task
-        task = get_task("logreg", cfg)
+        from kafka_ps_tpu.models.task import default_task
+        task = default_task(cfg)
     n_real = task.num_params
     param_shards = mesh.shape[PARAM_AXIS]
     n_pad = padded_num_params(task, param_shards)

@@ -45,6 +45,10 @@ class WorkerNode:
         self.buffer = buffer
         from kafka_ps_tpu.models.task import get_task
         self.task = get_task(cfg.task, cfg.model)
+        if cfg.use_pallas and cfg.task != "logreg":
+            raise ValueError(
+                "use_pallas implements the logreg local update only "
+                f"(ops/fused_update.py), got task {cfg.task!r}")
         self.theta = np.zeros((self.task.num_params,), dtype=np.float32)
         self.test_x = jnp.asarray(test_x) if test_x is not None else None
         self.test_y = jnp.asarray(test_y) if test_y is not None else None
@@ -69,7 +73,7 @@ class WorkerNode:
             raise RuntimeError(
                 f"There is no data in the buffer of worker {self.worker_id}")
 
-        if self.cfg.use_pallas and self.cfg.task == "logreg":
+        if self.cfg.use_pallas:    # logreg-only, enforced in __init__
             from kafka_ps_tpu.ops import fused_update
 
             def update_fn(theta, xx, yy, mm):
