@@ -3,6 +3,7 @@
   python -m kafka_ps_tpu.evaluation summarize --server logs-server.csv [--worker logs-worker.csv]
   python -m kafka_ps_tpu.evaluation plot      --server logs-server.csv [--worker ...] --out run.png
   python -m kafka_ps_tpu.evaluation compare   --runs name=path [name=path ...] --out cmp.png
+  python -m kafka_ps_tpu.evaluation validate  --worker logs-worker.csv [--server ...] -c K [--elastic]
   python -m kafka_ps_tpu.evaluation ground-truth --train train.csv --test test.csv
 
 Replaces the reference's three Jupyter notebooks (SURVEY §3.4) with
@@ -45,6 +46,15 @@ def main(argv=None) -> int:
     s.add_argument("--out")
     s.add_argument("--x", default="seconds", choices=["seconds", "vectorClock"])
 
+    s = sub.add_parser("validate")
+    s.add_argument("--worker")
+    s.add_argument("--server")
+    s.add_argument("-c", "--consistency_model", type=int, default=0)
+    s.add_argument("--elastic", action="store_true",
+                   help="run used failure_policy=rebalance: check clock "
+                        "monotonicity only (membership changes void the "
+                        "static staleness bound)")
+
     s = sub.add_parser("ground-truth")
     s.add_argument("--train", required=True)
     s.add_argument("--test", required=True)
@@ -75,6 +85,18 @@ def main(argv=None) -> int:
         print(table.to_string(index=False))
         if args.out:
             print(plots.plot_comparison(runs, args.out, x=args.x))
+    elif args.cmd == "validate":
+        from kafka_ps_tpu.evaluation import validate
+        if not args.worker and not args.server:
+            raise SystemExit("validate needs --worker and/or --server")
+        wdf = logs_mod.load_worker_log(args.worker) if args.worker else None
+        sdf = logs_mod.load_server_log(args.server) if args.server else None
+        violations = validate.validate_run(wdf, sdf, args.consistency_model,
+                                           elastic=args.elastic)
+        for v in violations:
+            print(f"VIOLATION [{v.rule}] {v.detail}")
+        print(f"{len(violations)} violation(s)")
+        return 1 if violations else 0
     elif args.cmd == "ground-truth":
         from kafka_ps_tpu.data.stream import load_csv_dataset
         from kafka_ps_tpu.evaluation import ground_truth

@@ -1,0 +1,107 @@
+"""Protocol conformance validation — the race/staleness auditor.
+
+The reference's only protocol safety nets are runtime assertions inside
+MessageTracker (clock-mismatch throws, MessageTracker.java:22-35 — its
+substitute for a race detector, SURVEY §5).  This module audits a
+finished run's logs against the consistency contract itself:
+
+  * per-worker vector clocks advance by exactly +1 (no lost or
+    duplicated iterations);
+  * the cross-worker staleness bound holds at every moment:
+    log-visible spread ≤ consistency_model + 1 (eventual −1:
+    unbounded, no check);
+  * the server's evaluation clock never regresses.
+
+Derivation of the bound: the gate releases weights clock c to a worker
+iff every gradient for iteration c − k − 1 has arrived, i.e. the
+slowest tracker clock m ≥ c − k (MessageTracker.java:69-87,
+parallel/tracker.py).  A tracker clock of m means that worker's last
+*logged* iteration is m − 1 (it logs c while processing weights c,
+before its gradient advances the tracker), so the spread between log
+lines is ≤ (c) − (m − 1) ≤ k + 1.  Sequential is k = 0 → spread ≤ 1.
+The TPU campaign in docs/EVALUATION.md measured 1 / 11 / 27 for
+k = 0 / 10 / eventual — at the bound for both checked models.  Usage:
+
+  python -m kafka_ps_tpu.evaluation validate \\
+      --worker logs-worker.csv --server logs-server.csv -c 10
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pandas as pd
+
+from kafka_ps_tpu.utils.config import EVENTUAL
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    detail: str
+
+
+def validate_worker_log(worker_df: pd.DataFrame,
+                        consistency_model: int,
+                        elastic: bool = False) -> list[Violation]:
+    """`elastic=True` validates a run with worker eviction/readmission
+    (failure_policy=rebalance): membership changes void the static
+    staleness bound (survivors legitimately run past an evicted
+    worker's frozen clock), so only per-worker clock monotonicity is
+    checked — readmission joins at the slowest *active* clock, which is
+    always strictly above the worker's own last logged clock, so clocks
+    stay strictly increasing even across a rejoin."""
+    out: list[Violation] = []
+    # 1. per-worker clocks
+    for w, g in worker_df.groupby("partition"):
+        clocks = g["vectorClock"].tolist()
+        for prev, cur in zip(clocks, clocks[1:]):
+            bad = (cur <= prev) if elastic else (cur != prev + 1)
+            if bad:
+                expect = "an increase" if elastic else f"{prev + 1}"
+                out.append(Violation(
+                    "clock-step",
+                    f"worker {int(w)}: clock {prev} -> {cur} "
+                    f"(expected {expect})"))
+    # 2. staleness bound, evaluated at every log event in arrival order
+    # (stable sort: ties keep file order — log files are written in
+    # arrival order and millisecond timestamps collide)
+    if consistency_model != EVENTUAL and not elastic:
+        bound = consistency_model + 1   # see module docstring
+        latest: dict[int, int] = {}
+        ordered = worker_df.sort_values("timestamp", kind="stable")
+        for _, row in ordered.iterrows():
+            latest[int(row["partition"])] = int(row["vectorClock"])
+            if len(latest) > 1:
+                spread = max(latest.values()) - min(latest.values())
+                if spread > bound:
+                    out.append(Violation(
+                        "staleness-bound",
+                        f"spread {spread} > bound {bound} at "
+                        f"timestamp {int(row['timestamp'])} "
+                        f"(clocks {dict(sorted(latest.items()))})"))
+    return out
+
+
+def validate_server_log(server_df: pd.DataFrame) -> list[Violation]:
+    out: list[Violation] = []
+    clocks = server_df["vectorClock"].tolist()
+    for prev, cur in zip(clocks, clocks[1:]):
+        if cur < prev:
+            out.append(Violation(
+                "server-clock-regression",
+                f"server eval clock {prev} -> {cur}"))
+    return out
+
+
+def validate_run(worker_df: pd.DataFrame | None,
+                 server_df: pd.DataFrame | None,
+                 consistency_model: int,
+                 elastic: bool = False) -> list[Violation]:
+    out: list[Violation] = []
+    if worker_df is not None and len(worker_df):
+        out += validate_worker_log(worker_df, consistency_model,
+                                   elastic=elastic)
+    if server_df is not None and len(server_df):
+        out += validate_server_log(server_df)
+    return out

@@ -259,19 +259,33 @@ class StreamingPSApp:
         # under BSP all active clocks are uniform; resume from the
         # restored one
         clock = min(self.server.tracker.clocks[w] for w in active)
+        # device-resident slab cache: between stream arrivals the loop
+        # re-trains on identical buffers (the reference's steady state,
+        # WorkerTrainingProcessor.java:63-97) — re-uploading ~16 MB of
+        # unchanged slabs per iteration would make host->device transfer
+        # the bottleneck.  num_tuples_seen strictly increases on every
+        # insert, so it is the buffer content version.
+        slab_versions: list[int] | None = None
+        x = y = mask = None
         while self.server.iterations < max_server_iterations:
-            slabs = []
-            for w in active:
-                x, y, mask = self.buffers[w].snapshot()
-                if mask.sum() == 0:
-                    raise RuntimeError(
-                        f"There is no data in the buffer of worker {w}")
-                slabs.append((x, y, mask))
-            x = np.stack([s[0] for s in slabs])
-            y = np.stack([s[1] for s in slabs])
-            mask = np.stack([s[2] for s in slabs])
-            if mesh is not None:
-                x, y, mask = bsp.shard_worker_batches(mesh, x, y, mask)
+            versions = [self.buffers[w].num_tuples_seen for w in active]
+            if versions != slab_versions:
+                slabs = []
+                for w in active:
+                    sx, sy, sm = self.buffers[w].snapshot()
+                    if sm.sum() == 0:
+                        raise RuntimeError(
+                            f"There is no data in the buffer of worker {w}")
+                    slabs.append((sx, sy, sm))
+                x = np.stack([s[0] for s in slabs])
+                y = np.stack([s[1] for s in slabs])
+                mask = np.stack([s[2] for s in slabs])
+                if mesh is not None:
+                    x, y, mask = bsp.shard_worker_batches(mesh, x, y, mask)
+                else:
+                    x, y, mask = (jnp.asarray(x), jnp.asarray(y),
+                                  jnp.asarray(mask))
+                slab_versions = versions
             with self.tracer.span("bsp.step", clock=clock + 1):
                 theta, mean_loss = step(theta, x, y, mask)
                 if self.tracer.enabled:

@@ -1,0 +1,94 @@
+"""Protocol conformance validator (evaluation/validate.py): clock-step,
+staleness-bound (k+1 envelope), server regression, and clean passes on
+runtime-produced logs."""
+
+import pandas as pd
+import pytest
+
+from kafka_ps_tpu.evaluation import logs, validate
+from kafka_ps_tpu.utils.config import EVENTUAL
+
+
+def _wdf(rows):
+    # rows: (timestamp, partition, vectorClock)
+    return pd.DataFrame([{"timestamp": t, "partition": p, "vectorClock": c,
+                          "loss": 0.0, "fMeasure": 0.0, "accuracy": 0.0,
+                          "numTuplesSeen": 0} for t, p, c in rows])
+
+
+def test_clean_sequential_log_passes():
+    rows = []
+    t = 0
+    for clock in range(5):
+        for w in range(3):
+            rows.append((t, w, clock))
+            t += 1
+    assert validate.validate_worker_log(_wdf(rows), 0) == []
+
+
+def test_clock_skip_detected():
+    rows = [(0, 0, 0), (1, 0, 2)]          # worker 0 skips clock 1
+    v = validate.validate_worker_log(_wdf(rows), EVENTUAL)
+    assert len(v) == 1 and v[0].rule == "clock-step"
+
+
+def test_staleness_bound_k_plus_one():
+    # worker 1 stuck at 0; worker 0 reaches k+1 = 3 -> spread 3 ok,
+    # then 4 -> violation
+    rows = [(0, 1, 0)] + [(i + 1, 0, i) for i in range(5)]
+    v = validate.validate_worker_log(_wdf(rows), 2)
+    assert any(x.rule == "staleness-bound" and "spread 4" in x.detail
+               for x in v)
+    assert not any("spread 3 " in x.detail for x in v)
+
+
+def test_eventual_has_no_staleness_check():
+    rows = [(0, 1, 0)] + [(i + 1, 0, i) for i in range(50)]
+    assert validate.validate_worker_log(_wdf(rows), EVENTUAL) == []
+
+
+def test_elastic_mode_allows_rejoin_jump_but_not_regression():
+    # worker 0 evicted after clock 2, readmitted at clock 9 (a jump)
+    rows = [(0, 0, 0), (1, 0, 1), (2, 0, 2), (50, 0, 9), (51, 0, 10)]
+    assert validate.validate_worker_log(_wdf(rows), 0, elastic=True) == []
+    strict = validate.validate_worker_log(_wdf(rows), 0)
+    assert any(v.rule == "clock-step" for v in strict)
+    # regression is still caught in elastic mode
+    bad = [(0, 0, 5), (1, 0, 3)]
+    v = validate.validate_worker_log(_wdf(bad), 0, elastic=True)
+    assert len(v) == 1 and v[0].rule == "clock-step"
+
+
+def test_server_clock_regression():
+    sdf = pd.DataFrame([{"timestamp": 0, "partition": -1, "vectorClock": 5,
+                         "loss": 0, "fMeasure": 0, "accuracy": 0},
+                        {"timestamp": 1, "partition": -1, "vectorClock": 3,
+                         "loss": 0, "fMeasure": 0, "accuracy": 0}])
+    v = validate.validate_server_log(sdf)
+    assert len(v) == 1 and v[0].rule == "server-clock-regression"
+
+
+@pytest.mark.parametrize("consistency", [0, 2, EVENTUAL])
+def test_live_runtime_logs_validate_clean(consistency):
+    """Logs produced by an actual serial run conform to the contract."""
+    from kafka_ps_tpu.data.synth import generate
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+    from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
+                                           PSConfig)
+
+    cfg = PSConfig(num_workers=3, consistency_model=consistency,
+                   model=ModelConfig(num_features=12, num_classes=3),
+                   buffer=BufferConfig(min_size=4, max_size=8))
+    x, y = generate(60, 12, 3, seed=1)
+    lines = []
+    app = StreamingPSApp(cfg, test_x=x[-12:], test_y=y[-12:],
+                         worker_log=lines.append)
+    for i in range(24):
+        app.data_sink(i % 3, {j: float(x[i, j]) for j in range(12)},
+                      int(y[i]))
+    app.run_serial(max_server_iterations=15, pump=lambda: None)
+    wdf = pd.DataFrame(
+        [dict(zip(["timestamp", "partition", "vectorClock", "loss",
+                   "fMeasure", "accuracy", "numTuplesSeen"],
+                  map(float, line.split(";")))) for line in lines])
+    assert validate.validate_worker_log(wdf, consistency) == []
