@@ -114,7 +114,11 @@ def load_test_csv(path: str, num_features: int):
     return x, y
 
 
-def make_app_from_args(args, resuming: bool = False):
+def make_app_from_args(args, resuming: bool = False,
+                       process_index: int = 0):
+    """`process_index` > 0 (a non-coordinator host of a multi-process
+    job) writes no server log and a process-suffixed worker log — one
+    writer per file on a shared filesystem (deploy/README.md)."""
     from kafka_ps_tpu.runtime.app import StreamingPSApp
     from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
                                            PSConfig, StreamConfig)
@@ -138,10 +142,13 @@ def make_app_from_args(args, resuming: bool = False):
     )
     test_x, test_y = load_test_csv(args.test_data_file_path,
                                    args.num_features)
-    server_log = CsvLogSink("./logs-server.csv" if args.logging else None,
-                            SERVER_HEADER, append=resuming)
-    worker_log = CsvLogSink("./logs-worker.csv" if args.logging else None,
-                            WORKER_HEADER, append=resuming)
+    suffix = f".p{process_index}" if process_index else ""
+    server_log = CsvLogSink(
+        "./logs-server.csv" if args.logging and process_index == 0 else None,
+        SERVER_HEADER, append=resuming)
+    worker_log = CsvLogSink(
+        f"./logs-worker{suffix}.csv" if args.logging else None,
+        WORKER_HEADER, append=resuming)
     tracer = None
     if getattr(args, "trace", None):
         from kafka_ps_tpu.utils.trace import Tracer
@@ -167,12 +174,16 @@ def run_with_args(args) -> int:
         raise SystemExit(
             "--pallas implements the logreg local update only "
             "(ops/fused_update.py); drop --pallas or use --task logreg")
-    if args.remote and not args.fused:
+    distributed = False
+    if args.remote:
         from kafka_ps_tpu.parallel import multihost
-        if multihost.initialize():
-            # joined a real multi-process job: only the fused BSP step
-            # runs over the global mesh; the host-orchestrated modes are
-            # single-host by design (deploy/README.md)
+        # join the job BEFORE building the app: process identity gates
+        # the log sinks and checkpoint writer below
+        distributed = multihost.initialize()
+        if distributed and not args.fused:
+            # only the fused BSP step runs over the global mesh; the
+            # host-orchestrated modes are single-host by design
+            # (deploy/README.md)
             raise SystemExit(
                 "-r joined a multi-host job but only --fused runs over "
                 "the global mesh; add --fused (or run the async "
@@ -185,8 +196,13 @@ def run_with_args(args) -> int:
             print(f"    {k}: {v}")
 
     import os
+    process_index = 0
+    if distributed:
+        import jax
+        process_index = jax.process_index()
     resuming = bool(args.checkpoint and os.path.exists(args.checkpoint))
-    app, logs = make_app_from_args(args, resuming=resuming)
+    app, logs = make_app_from_args(args, resuming=resuming,
+                                   process_index=process_index)
 
     if args.checkpoint:
         from kafka_ps_tpu.utils import checkpoint as ckpt
@@ -194,8 +210,26 @@ def run_with_args(args) -> int:
         if restored and args.verbose:
             print(f"    restored checkpoint at iteration "
                   f"{app.server.iterations}")
-        app.server.checkpoint_path = args.checkpoint
-        app.server.checkpoint_every = args.checkpoint_every
+        if process_index == 0:   # one checkpoint writer per job
+            app.server.checkpoint_path = args.checkpoint
+            app.server.checkpoint_every = args.checkpoint_every
+
+    # mesh + data-partition assignment come AFTER checkpoint restore: a
+    # restored checkpoint can carry evictions, and both the divisibility
+    # check and the local-worker filter must see the real membership
+    mesh = None
+    if args.fused and args.remote:
+        from kafka_ps_tpu.parallel import multihost
+        mesh = multihost.global_worker_mesh()
+        active = app.server.tracker.active_workers
+        if len(active) % mesh.devices.size != 0:
+            raise SystemExit(
+                f"{len(active)} active workers must be a "
+                f"multiple of the {mesh.devices.size}-device "
+                f"mesh in --remote mode")
+        if distributed:
+            local_pos = multihost.local_worker_ids(len(active), mesh)
+            app.local_workers = {active[i] for i in local_pos}
 
     producer = app.make_producer(args.training_data_file_path)
     producer.run_in_background()
@@ -206,17 +240,6 @@ def run_with_args(args) -> int:
     try:
         with device_trace(args.device_trace):
             if args.fused:
-                mesh = None
-                if args.remote:
-                    from kafka_ps_tpu.parallel import multihost
-                    multihost.initialize()
-                    mesh = multihost.global_worker_mesh()
-                    n_active = len(app.server.tracker.active_workers)
-                    if n_active % mesh.devices.size != 0:
-                        raise SystemExit(
-                            f"{n_active} active workers must be a "
-                            f"multiple of the {mesh.devices.size}-device "
-                            f"mesh in --remote mode")
                 app.run_fused_bsp(max_server_iterations=max_iters,
                                   mesh=mesh)
             elif args.mode == "serial":
@@ -230,7 +253,7 @@ def run_with_args(args) -> int:
         print("interrupted — shutting down", file=sys.stderr)
         app.stop()
     finally:
-        if args.checkpoint:
+        if args.checkpoint and process_index == 0:
             from kafka_ps_tpu.utils import checkpoint as ckpt
             ckpt.save(args.checkpoint, app.server)
         for log in logs:

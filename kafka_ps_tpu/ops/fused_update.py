@@ -49,8 +49,13 @@ def _kernel(x_ref, y_ref, mask_ref, w0_ref, b0_ref,
     batch = x.shape[0]
 
     class_ids = jax.lax.broadcasted_iota(jnp.int32, (batch, LANES), 1)
-    onehot = (class_ids == y).astype(jnp.float32)          # [B, C8]
     valid = (class_ids < num_rows).astype(jnp.float32)
+    # mask the onehot with the valid-class predicate: an out-of-range
+    # label (y >= num_rows) yields an all-zero row, so it contributes
+    # zero loss — matching jax.nn.one_hot in models/logreg.grad_loss
+    # (otherwise it would hit a -1e30-masked padded class and blow the
+    # reported loss up to ~1e30)
+    onehot = (class_ids == y).astype(jnp.float32) * valid  # [B, C8]
     neg_inf_pad = (1.0 - valid) * (-1e30)                  # kill padded classes
     denom = jnp.maximum(jnp.sum(mask), 1.0)
 

@@ -61,6 +61,11 @@ class StreamingPSApp:
         self._stop = threading.Event()
         self._reroute_counter = 0
         self.worker_failures: list[tuple[int, BaseException | str]] = []
+        # Multi-host: the subset of logical workers this process hosts
+        # (None = all).  Every host streams the same CSV with the same
+        # global round-robin, keeping only its own workers' rows — the
+        # per-broker-partition analogue (parallel/multihost.py).
+        self.local_workers: set[int] | None = None
 
     # -- ingestion sink (the INPUT_DATA topic hop) -------------------------
 
@@ -70,11 +75,16 @@ class StreamingPSApp:
         if not status.active:
             # partition reassignment: rows destined for an evicted worker
             # go round-robin to the survivors (the Kafka consumer-group
-            # rebalance analogue, SURVEY §5)
+            # rebalance analogue, SURVEY §5).  Reroute BEFORE the local
+            # filter: every host sees the same stream and membership, so
+            # the deterministic counter picks the same survivor
+            # everywhere and exactly one host keeps the row.
             active = self.server.tracker.active_workers
             worker = active[self._reroute_counter % len(active)]
             self._reroute_counter += 1
             self.tracer.count("data.rerouted_rows")
+        if self.local_workers is not None and worker not in self.local_workers:
+            return                  # another host's partition
         self.buffers[worker].add(features, label)
 
     def make_producer(self, csv_path: str, has_header: bool = True,
@@ -90,11 +100,24 @@ class StreamingPSApp:
         """The reference sleeps 20 s after starting the producer
         (ServerAppRunner.java:95); we wait on the actual invariant."""
         deadline = time.monotonic() + timeout
-        while any(self.buffers[w].count < min_per_worker
-                  for w in self.server.tracker.active_workers):
+        waiting = [w for w in self.server.tracker.active_workers
+                   if self.local_workers is None or w in self.local_workers]
+        while any(self.buffers[w].count < min_per_worker for w in waiting):
             if time.monotonic() > deadline:
                 raise TimeoutError("buffers not prefilled in time")
             time.sleep(0.01)
+
+    # -- membership --------------------------------------------------------
+
+    def readmit_worker(self, worker_id: int) -> int:
+        """Elastic scale-up through the app: rejoin the worker on the
+        server AND reset its compile-grace baseline so the supervisor
+        grants the first post-rejoin iteration the 10x jit grace."""
+        clock = self.server.readmit_worker(worker_id)
+        self.workers[worker_id].iterations_at_join = \
+            self.workers[worker_id].iterations
+        self.workers[worker_id].last_progress = time.monotonic()
+        return clock
 
     # -- drive loops -------------------------------------------------------
 
@@ -209,9 +232,14 @@ class StreamingPSApp:
                 # Staleness is measured from the LATEST of (worker's own
                 # last progress, server's weights-send stamp) so time a
                 # worker spent gate-blocked and idle doesn't count
-                # against it.  A worker on its very first iteration gets
-                # 10x grace: the first call pays jit compilation.
-                grace = (10.0 if self.workers[w].iterations == 0 else 1.0)
+                # against it.  A worker on its first iteration SINCE
+                # (re)admission gets 10x grace: that call may pay jit
+                # compilation (fresh start or a new code path after
+                # rejoin).  heartbeat_timeout must still exceed the
+                # worst-case steady-state single-iteration compute time.
+                wk = self.workers[w]
+                grace = (10.0 if wk.iterations == wk.iterations_at_join
+                         else 1.0)
                 baseline = max(self.workers[w].last_progress,
                                self.server.weights_sent_at[w])
                 hung = (self.server.tracker.tracker[w].weights_message_sent
@@ -244,6 +272,7 @@ class StreamingPSApp:
                       log_metrics: bool = True) -> None:
         """Sequential consistency as fused shard_map steps.  Each step is
         one full BSP iteration (all workers advance one clock)."""
+        import jax
         import jax.numpy as jnp
 
         if self.cfg.consistency_model != SEQUENTIAL:
@@ -259,6 +288,27 @@ class StreamingPSApp:
         # under BSP all active clocks are uniform; resume from the
         # restored one
         clock = min(self.server.tracker.clocks[w] for w in active)
+        # Multi-process job: this process hosts only the workers mapped
+        # to its local mesh devices — it feeds their buffers and builds
+        # the global arrays from its local slabs
+        # (jax.make_array_from_process_local_data); the device program
+        # is identical either way.
+        multiproc = mesh is not None and jax.process_count() > 1
+        if multiproc:
+            from kafka_ps_tpu.parallel import multihost
+            local_pos = multihost.local_worker_ids(len(active), mesh)
+            feed = [active[i] for i in local_pos]
+            # the data filter (set by the CLI before the producer
+            # started) must match this derivation — a stale filter from
+            # pre-restore membership starves buffers this process owns
+            if (self.local_workers is not None
+                    and set(feed) != set(self.local_workers)):
+                raise RuntimeError(
+                    f"local_workers {sorted(self.local_workers)} diverges "
+                    f"from the mesh-derived feed set {sorted(feed)} — "
+                    "membership changed after the data filter was set")
+        else:
+            feed = active
         # device-resident slab cache: between stream arrivals the loop
         # re-trains on identical buffers (the reference's steady state,
         # WorkerTrainingProcessor.java:63-97) — re-uploading ~16 MB of
@@ -268,10 +318,16 @@ class StreamingPSApp:
         slab_versions: list[int] | None = None
         x = y = mask = None
         while self.server.iterations < max_server_iterations:
-            versions = [self.buffers[w].num_tuples_seen for w in active]
+            versions = [self.buffers[w].num_tuples_seen for w in feed]
+            # The version cache stays valid multi-process: the global
+            # array build below (make_array_from_process_local_data) is
+            # process-local — device_put of this host's shards only, no
+            # cross-process rendezvous — so hosts may disagree about
+            # re-uploading without hanging, and a host whose buffers are
+            # unchanged reuses device slabs with identical content.
             if versions != slab_versions:
                 slabs = []
-                for w in active:
+                for w in feed:
                     sx, sy, sm = self.buffers[w].snapshot()
                     if sm.sum() == 0:
                         raise RuntimeError(
@@ -280,7 +336,11 @@ class StreamingPSApp:
                 x = np.stack([s[0] for s in slabs])
                 y = np.stack([s[1] for s in slabs])
                 mask = np.stack([s[2] for s in slabs])
-                if mesh is not None:
+                if multiproc:
+                    from kafka_ps_tpu.parallel import multihost
+                    x, y, mask = multihost.shard_worker_batches_global(
+                        mesh, x, y, mask)
+                elif mesh is not None:
                     x, y, mask = bsp.shard_worker_batches(mesh, x, y, mask)
                 else:
                     x, y, mask = (jnp.asarray(x), jnp.asarray(y),
@@ -308,15 +368,20 @@ class StreamingPSApp:
                                               self.server.test_y)
                 self.server.last_metrics = m
                 now = int(time.time() * 1000)
-                self.server.log(
-                    f"{now};-1;{clock};{float(m.loss)};"
-                    f"{float(m.f1)};{float(m.accuracy)}")
+                # multi-process: the server line is process 0's alone
+                # (identical replicated metrics; one writer per file)
+                if not multiproc or jax.process_index() == 0:
+                    self.server.log(
+                        f"{now};-1;{clock};{float(m.loss)};"
+                        f"{float(m.f1)};{float(m.accuracy)}")
                 # Worker log lines, same schema/cadence as the per-node
                 # path (WorkerTrainingProcessor.java:85-92).  The fused
                 # step returns the mean local training loss; test metrics
                 # are identical across workers under BSP (replicated
-                # weights), so each line carries the shared values.
-                for w in active:
+                # weights), so each line carries the shared values.  Each
+                # process logs only the workers it hosts (its sink path
+                # is process-suffixed in multi-host mode, cli/run.py).
+                for w in feed:
                     self.workers[w].log(
                         f"{now};{w};{clock};{float(mean_loss)};"
                         f"{float(m.f1)};{float(m.accuracy)};"

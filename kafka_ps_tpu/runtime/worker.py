@@ -54,6 +54,10 @@ class WorkerNode:
         self.test_y = jnp.asarray(test_y) if test_y is not None else None
         self.log = log or (lambda line: None)
         self.iterations = 0
+        # iterations counted at (re)admission: the supervisor grants the
+        # jit-compile grace to the first iteration *since joining*, not
+        # just the process-lifetime first (runtime/app.py supervisor)
+        self.iterations_at_join = 0
         # failure-detection heartbeat (read by the supervisor in
         # runtime/app.py): wall-clock of the last completed iteration
         self.last_progress = time.monotonic()

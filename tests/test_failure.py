@@ -251,3 +251,22 @@ def test_threaded_rebalance_halts_when_no_workers_left():
     with pytest.raises(RuntimeError, match="worker thread failed"):
         app.run_threaded(max_server_iterations=100, poll_timeout=0.02,
                          failure_policy="rebalance")
+
+
+def test_app_readmission_resets_compile_grace():
+    """app.readmit_worker stamps iterations_at_join so the supervisor's
+    10x jit-compile grace applies to the first post-rejoin iteration,
+    not only to a worker's process-lifetime first iteration."""
+    app = _make_app(num_workers=3)
+    app.server.start_training_loop()
+    app.run_serial(max_server_iterations=6)      # every worker iterated
+    assert app.workers[1].iterations > 0
+    app.server.remove_worker(1)
+    before = app.workers[1].iterations
+    clock = app.readmit_worker(1)
+    assert app.server.tracker.tracker[1].active
+    assert app.workers[1].iterations_at_join == before
+    assert clock >= 0
+    # the worker still contributes after rejoin through the app API
+    app.run_serial(max_server_iterations=app.server.iterations + 3)
+    assert app.workers[1].iterations > before

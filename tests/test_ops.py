@@ -86,3 +86,19 @@ def test_fallback_refusal_when_disallowed():
     with pytest.raises(ValueError, match="pallas local_update unavailable"):
         fused_update.local_update(_theta(), x, y, mask, cfg=CFG,
                                   allow_fallback=False)
+
+
+def test_out_of_range_label_loss_matches_xla_path():
+    """An out-of-range label (y >= num_classes+1) must contribute ZERO
+    loss in the kernel, exactly like jax.nn.one_hot's all-zero row in
+    the XLA path — not hit a -1e30-masked padded class."""
+    x, y, mask = _batch(n=16)
+    y = y.at[3].set(CFG.num_classes + 7)     # invalid label, masked-in row
+    theta = _theta()
+    d_ref, loss_ref = logreg.local_update(theta, x, y, mask, cfg=CFG)
+    d_pl, loss_pl = fused_update.local_update(theta, x, y, mask, cfg=CFG,
+                                              interpret=True)
+    assert float(loss_pl) == pytest.approx(float(loss_ref), rel=2e-4)
+    assert abs(float(loss_pl)) < 1e6         # not blown up to ~1e30
+    np.testing.assert_allclose(np.asarray(d_pl), np.asarray(d_ref),
+                               rtol=2e-4, atol=2e-5)

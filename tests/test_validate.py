@@ -92,3 +92,11 @@ def test_live_runtime_logs_validate_clean(consistency):
                    "fMeasure", "accuracy", "numTuplesSeen"],
                   map(float, line.split(";")))) for line in lines])
     assert validate.validate_worker_log(wdf, consistency) == []
+
+
+def test_elastic_mode_allows_equal_clock_on_rejoin():
+    """Readmission joins at the min ACTIVE clock, which equals the
+    evicted worker's own last logged clock when survivors have not
+    advanced — the worker legitimately re-logs the same clock."""
+    rows = [(0, 0, 0), (1, 0, 1), (2, 0, 2), (50, 0, 2), (51, 0, 3)]
+    assert validate.validate_worker_log(_wdf(rows), 0, elastic=True) == []
