@@ -122,8 +122,8 @@ def make_app_from_args(args, resuming: bool = False,
     from kafka_ps_tpu.runtime.app import StreamingPSApp
     from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
                                            PSConfig, StreamConfig)
-    from kafka_ps_tpu.utils.csvlog import (CsvLogSink, SERVER_HEADER,
-                                           WORKER_HEADER)
+    from kafka_ps_tpu.utils.csvlog import (CsvLogSink, NullLogSink,
+                                           SERVER_HEADER, WORKER_HEADER)
 
     cfg = PSConfig(
         num_workers=args.num_workers,
@@ -143,9 +143,14 @@ def make_app_from_args(args, resuming: bool = False,
     test_x, test_y = load_test_csv(args.test_data_file_path,
                                    args.num_features)
     suffix = f".p{process_index}" if process_index else ""
-    server_log = CsvLogSink(
-        "./logs-server.csv" if args.logging and process_index == 0 else None,
-        SERVER_HEADER, append=resuming)
+    if process_index == 0:
+        server_log = CsvLogSink(
+            "./logs-server.csv" if args.logging else None,
+            SERVER_HEADER, append=resuming)
+    else:
+        # a CsvLogSink(None) falls back to stdout (the reference's
+        # default); non-coordinator processes must write NO server log
+        server_log = NullLogSink()
     worker_log = CsvLogSink(
         f"./logs-worker{suffix}.csv" if args.logging else None,
         WORKER_HEADER, append=resuming)
@@ -165,6 +170,15 @@ def main(argv=None) -> int:
 
 
 def run_with_args(args) -> int:
+    import os
+    platform = os.environ.get("KPS_PLATFORM")
+    if platform:
+        # deployment hook: pin the JAX platform (e.g. KPS_PLATFORM=cpu
+        # for a broker-less smoke run or a CPU-mesh CI job).  Must happen
+        # before first backend use; a plain JAX_PLATFORMS env var can be
+        # overridden by accelerator plugins at interpreter start.
+        import jax
+        jax.config.update("jax_platforms", platform)
     if args.fused and args.pallas:
         raise SystemExit(
             "--pallas applies to the per-node worker path only; the "
@@ -195,7 +209,6 @@ def run_with_args(args) -> int:
         for k, v in sorted(vars(args).items()):
             print(f"    {k}: {v}")
 
-    import os
     process_index = 0
     if distributed:
         import jax

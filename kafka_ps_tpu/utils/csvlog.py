@@ -16,6 +16,17 @@ SERVER_HEADER = "timestamp;partition;vectorClock;loss;fMeasure;accuracy"
 WORKER_HEADER = SERVER_HEADER + ";numTuplesSeen"
 
 
+class NullLogSink:
+    """Discard-everything sink (e.g. the server log on non-coordinator
+    processes of a multi-host job — one writer per file)."""
+
+    def __call__(self, line: str) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
 class CsvLogSink:
     """Thread-safe line sink to a file (with header) or stdout.
 

@@ -15,3 +15,8 @@ import jax  # noqa: E402
 # The environment's TPU plugin may force jax_platforms back to the
 # accelerator at interpreter start; pin CPU before any backend init.
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process end-to-end jobs (seconds each)")
