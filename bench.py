@@ -1,4 +1,5 @@
-"""Benchmark — the reference's headline numbers on TPU.
+"""Benchmark — the reference's headline numbers on TPU, as a per-path
+matrix.
 
 Reference bar (BASELINE.md, from evaluation/logs/*.csv): best 4-worker
 config sustains 0.42 server iterations/s (4w @2.5tps) and 0.73–1.85
@@ -7,9 +8,17 @@ aggregate worker-updates/s on the fine-food-reviews workload
 
 This bench runs the same logical workload compute-bound (buffers
 prefilled, no producer pacing — the reference numbers are ingestion-
-throttled, so this measures the framework's own ceiling): 4 logical
-workers, sequential/BSP consistency, full 6150-parameter model, fused
-multi-round BSP steps on the TPU.
+throttled, so this measures the framework's own ceiling) on the HARD
+data regime (data/synth.generate_hard: offline F1 ceiling ~0.54, like
+the reference's non-separable task) so the reported F1 is non-trivial.
+
+Paths measured (all same process, interleaved trials — the only
+trustworthy comparison through the high-variance tunneled transport):
+  * fused BSP multi-round steps (the headline; logreg)
+  * fused BSP with the MLP task
+  * pallas fused local-update kernel vs the XLA path (A/B)
+  * per-node (message-driven) runtime at eval_every=1 (reference
+    cadence) and eval_every=10 (the throughput/cadence trade-off knob)
 
 Prints ONE JSON line:
   {"metric": "worker_updates_per_sec", "value": ..., "unit": "updates/s",
@@ -26,12 +35,26 @@ import time
 import numpy as np
 
 
+def _interleaved_best(fns: dict, trials: int = 3) -> dict[str, float]:
+    """Best-of-N wall-clock per labelled thunk, round-robin interleaved
+    so tunnel-latency drift hits every candidate equally."""
+    best = {k: float("inf") for k in fns}
+    for _ in range(trials):
+        for k, fn in fns.items():
+            t0 = time.perf_counter()
+            fn()
+            best[k] = min(best[k], time.perf_counter() - t0)
+    return best
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from kafka_ps_tpu.data.synth import generate
+    from kafka_ps_tpu.data.synth import generate_hard
     from kafka_ps_tpu.models import metrics as metrics_mod
+    from kafka_ps_tpu.models.task import get_task
+    from kafka_ps_tpu.ops import fused_update
     from kafka_ps_tpu.parallel import bsp
     from kafka_ps_tpu.utils.config import ModelConfig
 
@@ -40,9 +63,8 @@ def main() -> None:
     cfg = ModelConfig()        # 1024 features, 5 classes, k=2 -> 6150 params
     server_lr = 1.0 / num_workers
 
-    x, y = generate(num_workers * buffer_cap + 2000, cfg.num_features,
-                    cfg.num_classes, seed=1)
-    test_x, test_y = x[-2000:], y[-2000:]
+    x, y = generate_hard(num_workers * buffer_cap + 2000, seed=1)
+    test_x, test_y = jnp.asarray(x[-2000:]), jnp.asarray(y[-2000:])
     xb = x[:num_workers * buffer_cap].reshape(num_workers, buffer_cap,
                                               cfg.num_features)
     yb = y[:num_workers * buffer_cap].reshape(num_workers, buffer_cap)
@@ -59,10 +81,7 @@ def main() -> None:
     theta, _ = step(theta, xb, yb, mb)
     np.asarray(theta)
 
-    # best-of-3 trials: the tunneled transport adds high-variance host
-    # latency; the ceiling (fastest trial) is the stable compute metric.
-    # theta keeps accumulating across trials so the final metrics reflect
-    # all the training done, independent of the timing restructure.
+    # -- headline: fused BSP multi-round throughput (best-of-3) ------------
     calls = 20
     best_dt = float("inf")
     for _ in range(3):
@@ -76,9 +95,76 @@ def main() -> None:
     rounds = calls * rounds_per_call
     worker_updates = rounds * num_workers
     updates_per_sec = worker_updates / dt
+    m = metrics_mod.evaluate(theta, test_x, test_y, cfg=cfg)
 
-    m = metrics_mod.evaluate(theta, jnp.asarray(test_x), jnp.asarray(test_y),
-                             cfg=cfg)
+    # -- pallas vs XLA local update, interleaved A/B -----------------------
+    # One worker's single iteration at reference shapes — the per-node
+    # hot op (ops/fused_update.py vs models/logreg.local_update).
+    from kafka_ps_tpu.models import logreg
+    x1, y1, m1 = xb[0], yb[0], mb[0]
+    th1 = jnp.asarray(theta)
+    on_tpu = jax.default_backend() == "tpu"
+
+    pallas_ab = None
+    if on_tpu and fused_update.fits_in_vmem(buffer_cap, cfg.num_features):
+        fns = {
+            "xla": lambda: logreg.local_update(th1, x1, y1, m1, cfg=cfg)[0],
+            "pallas": lambda: fused_update.local_update(
+                th1, x1, y1, m1, cfg=cfg, allow_fallback=False)[0],
+        }
+        for f in fns.values():
+            np.asarray(f())              # compile both before timing
+        reps = 100
+
+        def many(fn):
+            # pipeline `reps` async dispatches, sync once: measures the
+            # per-call device cost, not the tunnel's per-call host
+            # round-trip (which swamps any kernel difference)
+            def go():
+                last = None
+                for _ in range(reps):
+                    last = fn()
+                jax.block_until_ready(last)
+            return go
+
+        ab = _interleaved_best({k: many(f) for k, f in fns.items()})
+        pallas_ab = {
+            "xla_local_updates_per_sec": round(reps / ab["xla"], 1),
+            "pallas_local_updates_per_sec": round(reps / ab["pallas"], 1),
+            "pallas_speedup": round(ab["xla"] / ab["pallas"], 3),
+        }
+
+    # -- fused MLP task (second model family) ------------------------------
+    mlp_task = get_task("mlp", cfg)
+    mlp_step = bsp.make_bsp_multi_step(cfg, num_workers, server_lr,
+                                       rounds_per_call, task=mlp_task)
+    theta_mlp, _ = mlp_step(mlp_task.init_params(), xb, yb, mb)
+    np.asarray(theta_mlp)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        theta_mlp, _ = mlp_step(theta_mlp, xb, yb, mb)
+    np.asarray(theta_mlp)
+    mlp_rounds_per_sec = 5 * rounds_per_call / (time.perf_counter() - t0)
+
+    # -- per-node (message-driven) path: the eval_every trade-off ----------
+    def per_node_iters_per_sec(eval_every: int, iters: int) -> float:
+        from kafka_ps_tpu.runtime.app import StreamingPSApp
+        from kafka_ps_tpu.utils.config import BufferConfig, PSConfig
+        pcfg = PSConfig(num_workers=num_workers, consistency_model=0,
+                        model=cfg, eval_every=eval_every,
+                        buffer=BufferConfig(max_size=256))
+        app = StreamingPSApp(pcfg, test_x=x[-2000:], test_y=y[-2000:])
+        for i in range(num_workers * 256):
+            app.data_sink(i % num_workers,
+                          dict(enumerate(x[i])), int(y[i]))
+        app.run_serial(max_server_iterations=4)     # compile + warm
+        t0 = time.perf_counter()
+        app.run_serial(max_server_iterations=4 + iters)
+        return iters / (time.perf_counter() - t0)
+
+    per_node_ref_cadence = per_node_iters_per_sec(1, 12)
+    per_node_eval10 = per_node_iters_per_sec(10, 40)
+
     baseline = 1.85   # best aggregate worker-updates/s in reference logs
     print(json.dumps({
         "metric": "worker_updates_per_sec",
@@ -90,10 +176,19 @@ def main() -> None:
             "vs_baseline_rounds": round(rounds / dt / 0.42, 1),
             "final_f1": round(float(m.f1), 4),
             "final_accuracy": round(float(m.accuracy), 4),
+            "dataset": "hard (offline F1 ceiling ~0.54, data/synth.py)",
             "num_workers": num_workers,
             "buffer_size": buffer_cap,
             "model_params": cfg.num_params,
             "device": str(jax.devices()[0]),
+            "paths": {
+                "fused_mlp_rounds_per_sec": round(mlp_rounds_per_sec, 1),
+                "pallas_ab": pallas_ab,
+                "per_node_iters_per_sec_eval_every_1":
+                    round(per_node_ref_cadence, 2),
+                "per_node_iters_per_sec_eval_every_10":
+                    round(per_node_eval10, 2),
+            },
         },
     }))
 

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Launch an N-process distributed job on ONE machine (CPU backend) —
+# the zero-infrastructure way to see the multi-host path run, exactly
+# what tests/test_multiprocess.py automates.  The reference's analogue
+# is run.sh (worker JVM + server JVM against a local broker).
+#
+#   deploy/launch_local_multihost.sh [N_PROCESSES] [extra cli args...]
+#
+# Writes logs-server.csv (+ logs-worker*.csv) into $PWD.
+set -euo pipefail
+
+NPROCS="${1:-2}"
+shift || true
+PORT=$(( 20000 + RANDOM % 20000 ))
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+export KPS_PLATFORM=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=2"
+export KPS_COORDINATOR="127.0.0.1:$PORT"
+export KPS_NUM_PROCESSES="$NPROCS"
+
+if [ ! -f ./train.csv ]; then
+  python -m kafka_ps_tpu.data.synth --out_dir . --rows 2000 \
+      --test_rows 400 --hard --num_features 64
+fi
+
+pids=()
+for i in $(seq 0 $((NPROCS - 1))); do
+  KPS_PROCESS_ID="$i" python -m kafka_ps_tpu.cli.run \
+      -training ./train.csv -test ./test.csv --num_features 64 \
+      --num_workers "$((NPROCS * 2))" --fused -r -l -p 1 \
+      --max_iterations 200 "$@" &
+  pids+=($!)
+done
+for p in "${pids[@]}"; do wait "$p"; done
+echo "done: $(wc -l < logs-server.csv) server log lines"

@@ -67,12 +67,23 @@ def build_parser(include_server_flags: bool = True,
                    help="k local solver steps per iteration "
                         "(numMaxIter, LogisticRegressionTaskSpark.java:35)")
     p.add_argument("--local_learning_rate", type=float, default=0.5)
+    p.add_argument("--eval_every", type=int, default=1,
+                   help="evaluate test metrics every Nth vector clock "
+                        "(1 = the reference's every-iteration cadence, "
+                        "LogisticRegressionTaskSpark.java:186; larger "
+                        "values trade metric resolution for throughput "
+                        "— eval dominates per-node wall-clock)")
     p.add_argument("--max_iterations", type=int, default=0,
                    help="stop after this many server iterations "
                         "(0 = run until Ctrl-C, like the reference)")
     p.add_argument("--fused", action="store_true",
                    help="sequential model as fused shard_map steps "
                         "(TPU fast path)")
+    p.add_argument("--param_shards", type=int, default=1,
+                   help="with --fused: shard the parameter vector over "
+                        "this many devices (2-D workers x params mesh — "
+                        "the reference's latent KeyRange axis, "
+                        "messages/KeyRange.java, parallel/range_sharded.py)")
     p.add_argument("--trace", default=None, metavar="PATH",
                    help="write a Chrome trace-event JSON (spans + message "
                         "counters) on exit and print span stats — replaces "
@@ -139,6 +150,7 @@ def make_app_from_args(args, resuming: bool = False,
                             coefficient=args.buffer_size_coefficient),
         stream=StreamConfig(time_per_event_ms=args.producer_time_per_event),
         use_pallas=args.pallas,
+        eval_every=getattr(args, "eval_every", 1),
     )
     test_x, test_y = load_test_csv(args.test_data_file_path,
                                    args.num_features)
@@ -169,21 +181,32 @@ def main(argv=None) -> int:
     return run_with_args(args)
 
 
-def run_with_args(args) -> int:
+def apply_platform_env() -> None:
+    """Deployment hook shared by every CLI entry (this runner and the
+    socket roles, cli/socket_mode.py): KPS_PLATFORM pins the JAX
+    platform (e.g. =cpu for a broker-less smoke run or a CPU-mesh CI
+    job).  Must happen before first backend use; a plain JAX_PLATFORMS
+    env var can be overridden by accelerator plugins at interpreter
+    start."""
     import os
     platform = os.environ.get("KPS_PLATFORM")
     if platform:
-        # deployment hook: pin the JAX platform (e.g. KPS_PLATFORM=cpu
-        # for a broker-less smoke run or a CPU-mesh CI job).  Must happen
-        # before first backend use; a plain JAX_PLATFORMS env var can be
-        # overridden by accelerator plugins at interpreter start.
         import jax
         jax.config.update("jax_platforms", platform)
+
+
+def run_with_args(args) -> int:
+    apply_platform_env()
+    if getattr(args, "eval_every", 1) < 1:
+        raise SystemExit("--eval_every must be >= 1")
     if args.fused and args.pallas:
         raise SystemExit(
             "--pallas applies to the per-node worker path only; the "
             "--fused BSP path runs its own shard_map program "
             "(parallel/bsp.py) — drop one of the two flags")
+    if getattr(args, "param_shards", 1) > 1 and not args.fused:
+        raise SystemExit("--param_shards requires --fused (the "
+                         "range-sharded server is a fused-mesh mode)")
     if args.pallas and args.task != "logreg":
         raise SystemExit(
             "--pallas implements the logreg local update only "
@@ -231,7 +254,28 @@ def run_with_args(args) -> int:
     # restored checkpoint can carry evictions, and both the divisibility
     # check and the local-worker filter must see the real membership
     mesh = None
-    if args.fused and args.remote:
+    param_shards = getattr(args, "param_shards", 1)
+    if param_shards > 1:
+        if distributed:
+            raise SystemExit("--param_shards is single-process (drop the "
+                             "KPS_* multi-process env, or use plain -r)")
+        import jax
+
+        from kafka_ps_tpu.parallel import mesh as mesh_mod
+        n_dev = len(jax.devices())
+        if n_dev % param_shards != 0:
+            raise SystemExit(
+                f"--param_shards {param_shards} must divide the device "
+                f"count {n_dev}")
+        mesh = mesh_mod.worker_param_mesh(n_dev // param_shards,
+                                          param_shards)
+        active = app.server.tracker.active_workers
+        if len(active) % mesh.devices.size != 0:
+            raise SystemExit(
+                f"{len(active)} active workers must be a multiple of "
+                f"the {mesh.devices.size}-device mesh (workers shard "
+                "over both mesh axes)")
+    elif args.fused and args.remote:
         from kafka_ps_tpu.parallel import multihost
         mesh = multihost.global_worker_mesh()
         active = app.server.tracker.active_workers

@@ -20,10 +20,22 @@ def main(argv=None) -> int:
     parser = run_mod.build_parser(include_server_flags=True,
                                   include_worker_flags=False,
                                   prog="ServerAppRunner")
+    parser.add_argument(
+        "--listen", type=int, default=None, metavar="PORT",
+        help="split deployment: host ONLY the server (aggregator + "
+             "consistency gate + producer) and serve remote worker "
+             "processes over the socket transport (cli/socket_mode.py; "
+             "0 = ephemeral port, printed to stderr) — the reference's "
+             "separate-server-JVM topology (run.sh:15-18)")
+    parser.add_argument("--connect_timeout", type=float, default=60.0,
+                        help="--listen: seconds to wait for all workers")
     args = parser.parse_args(argv)
     # worker-side defaults (WorkerAppRunner.java:55-58)
     args = argparse.Namespace(min_buffer_size=128, max_buffer_size=1024,
                               buffer_size_coefficient=0.3, **vars(args))
+    if args.listen is not None:
+        from kafka_ps_tpu.cli import socket_mode
+        return socket_mode.run_server(args)
     return run_mod.run_with_args(args)
 
 

@@ -1,0 +1,171 @@
+"""Split server/worker deployment over the socket transport — the
+reference's ACTUAL process topology (one server JVM + worker JVMs
+coupled through the broker, run.sh:10-18, kubernetes/*.yaml) for the
+async consistency models.
+
+    # host A — aggregator + consistency gate + stream producer
+    python -m kafka_ps_tpu.cli.server_runner --listen 8477 \
+        -c 10 -training train.csv -test test.csv --max_iterations 400 -l
+
+    # host B (and C, ...) — the workers named by --worker_ids
+    python -m kafka_ps_tpu.cli.worker_runner --connect hostA:8477 \
+        --worker_ids 0,1,2,3 -test test.csv -l
+
+WEIGHTS / GRADIENTS / INPUT_DATA cross the wire as binary serde frames
+(runtime/net.py, runtime/serde.py) — ~24 KB per 6150-float model
+message vs the reference's ~120 KB JSON.  The fused/BSP path scales via
+jax.distributed instead (deploy/README.md); this mode exists so bounded
+delay and eventual consistency have a real multi-host story too.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+import numpy as np
+
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime import net
+
+
+def _make_cfg(args):
+    from kafka_ps_tpu.cli.run import apply_platform_env
+    from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
+                                           PSConfig, StreamConfig)
+    apply_platform_env()
+    if getattr(args, "eval_every", 1) < 1:
+        raise SystemExit("--eval_every must be >= 1")
+    return PSConfig(
+        num_workers=args.num_workers,
+        consistency_model=getattr(args, "consistency_model", 0),
+        task=args.task,
+        model=ModelConfig(num_features=args.num_features,
+                          num_classes=args.num_classes,
+                          num_max_iter=args.local_iterations,
+                          local_learning_rate=args.local_learning_rate,
+                          hidden_dim=args.hidden_dim),
+        buffer=BufferConfig(
+            min_size=getattr(args, "min_buffer_size", 128),
+            max_size=getattr(args, "max_buffer_size", 1024),
+            coefficient=getattr(args, "buffer_size_coefficient", 0.3)),
+        stream=StreamConfig(time_per_event_ms=getattr(
+            args, "producer_time_per_event", 200)),
+        eval_every=getattr(args, "eval_every", 1),
+        use_pallas=getattr(args, "pallas", False),
+    )
+
+
+def run_server(args) -> int:
+    """Server role: ServerNode + producer, all workers remote."""
+    from kafka_ps_tpu.cli.run import load_test_csv
+    from kafka_ps_tpu.data.stream import CsvStreamProducer
+    from kafka_ps_tpu.runtime.server import ServerNode
+    from kafka_ps_tpu.utils.csvlog import CsvLogSink, SERVER_HEADER
+
+    cfg = _make_cfg(args)
+    test_x, test_y = load_test_csv(args.test_data_file_path,
+                                   args.num_features)
+    log = CsvLogSink("./logs-server.csv" if args.logging else None,
+                     SERVER_HEADER)
+    bridge = net.ServerBridge(port=args.listen)
+    print(f"listening on port {bridge.port}", file=sys.stderr, flush=True)
+    fabric = bridge.wrap(fabric_mod.Fabric())
+    server = ServerNode(cfg, fabric, test_x, test_y, log)
+
+    workers = list(range(cfg.num_workers))
+    bridge.wait_for_connected(workers, timeout=args.connect_timeout)
+
+    def sink(worker: int, features: dict[int, float], label: int) -> None:
+        bridge.send_data(worker, features, label)
+
+    producer = CsvStreamProducer(
+        args.training_data_file_path, cfg.num_workers, sink,
+        time_per_event_ms=cfg.stream.time_per_event_ms,
+        prefill_per_worker=cfg.stream.prefill_per_worker)
+    producer.run_in_background()
+    bridge.wait_for_workers(workers, timeout=args.connect_timeout)
+
+    server.start_training_loop()
+    max_iters = args.max_iterations or sys.maxsize
+    try:
+        while server.iterations < max_iters:
+            g = fabric.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0,
+                                     timeout=0.2)
+            if g is not None:
+                server.process(g)
+    finally:
+        bridge.close()       # workers see EOF and shut down
+        log.close()
+    return 0
+
+
+def run_worker(args) -> int:
+    """Worker role: the logical workers in --worker_ids, server remote."""
+    from kafka_ps_tpu.cli.run import load_test_csv
+    from kafka_ps_tpu.data.buffer import SlidingBuffer
+    from kafka_ps_tpu.runtime.worker import WorkerNode
+    from kafka_ps_tpu.utils.csvlog import CsvLogSink, WORKER_HEADER
+
+    host, _, port = args.connect.rpartition(":")
+    ids = [int(w) for w in args.worker_ids.split(",")]
+    cfg = _make_cfg(args)
+    test_x, test_y = load_test_csv(args.test_data_file_path,
+                                   args.num_features)
+    log = CsvLogSink("./logs-worker.csv" if args.logging else None,
+                     WORKER_HEADER)
+
+    bridge = net.WorkerBridge(host or "127.0.0.1", int(port), ids)
+    fabric = bridge.make_fabric()
+    buffers = {w: SlidingBuffer(cfg.model.num_features, cfg.buffer)
+               for w in ids}
+    nodes = {w: WorkerNode(w, cfg, fabric, buffers[w], test_x, test_y, log)
+             for w in ids}
+
+    threading.Thread(target=bridge.run_reader, args=(buffers,),
+                     daemon=True, name="kps-worker-reader").start()
+
+    # READY per worker once its buffer has data (the server gates the
+    # training-loop bootstrap on this, net.ServerBridge.wait_for_workers)
+    def announce_ready():
+        pending = set(ids)
+        while pending and not bridge.disconnected.is_set():
+            for w in list(pending):
+                if buffers[w].count > 0:
+                    bridge.mark_ready(w)
+                    pending.discard(w)
+            time.sleep(0.01)
+
+    threading.Thread(target=announce_ready, daemon=True).start()
+
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def worker_loop(node: WorkerNode):
+        try:
+            while not stop.is_set():
+                msg = fabric.poll_blocking(fabric_mod.WEIGHTS_TOPIC,
+                                           node.worker_id, timeout=0.1)
+                if msg is not None:
+                    node.on_weights(msg)
+        except (ConnectionError, OSError):
+            pass                      # server hung up mid-send
+        except BaseException as e:    # pragma: no cover - diagnostics
+            errors.append(e)
+            stop.set()
+
+    threads = [threading.Thread(target=worker_loop, args=(nodes[w],),
+                                daemon=True, name=f"worker-{w}")
+               for w in ids]
+    for t in threads:
+        t.start()
+    bridge.disconnected.wait()        # run until the server closes
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    log.close()
+    bridge.close()
+    if errors:
+        raise RuntimeError("worker failed") from errors[0]
+    return 0

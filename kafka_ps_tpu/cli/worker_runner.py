@@ -18,11 +18,23 @@ def main(argv=None) -> int:
     parser = run_mod.build_parser(include_server_flags=False,
                                   include_worker_flags=True,
                                   prog="WorkerAppRunner")
+    parser.add_argument(
+        "--connect", default=None, metavar="HOST:PORT",
+        help="split deployment: host ONLY the logical workers in "
+             "--worker_ids against a remote --listen server "
+             "(cli/socket_mode.py) — the reference's worker-JVM role "
+             "(run.sh:10-13)")
+    parser.add_argument("--worker_ids", default="0",
+                        help="--connect: comma-separated logical worker "
+                             "ids this process hosts")
     args = parser.parse_args(argv)
     # server-side defaults (ServerAppRunner.java:59-63, BaseKafkaApp.java:35)
     args = argparse.Namespace(training_data_file_path="./data/train.csv",
                               consistency_model=0,
                               producer_time_per_event=200, **vars(args))
+    if args.connect is not None:
+        from kafka_ps_tpu.cli import socket_mode
+        return socket_mode.run_worker(args)
     return run_mod.run_with_args(args)
 
 

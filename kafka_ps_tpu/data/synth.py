@@ -21,18 +21,53 @@ import numpy as np
 
 def generate(rows: int, num_features: int = 1024, num_classes: int = 5,
              noise: float = 2.0, sparsity: float = 0.7,
-             seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+             seed: int = 0, center_scale: float = 1.0,
+             label_noise: float = 0.0) -> tuple[np.ndarray, np.ndarray]:
     """(x, y) with y in 1..num_classes (the reference's label convention,
-    LogisticRegressionTaskSpark.java:122-140)."""
+    LogisticRegressionTaskSpark.java:122-140).
+
+    `center_scale` shrinks the class centers toward each other
+    (class overlap) and `label_noise` flips that fraction of labels to a
+    uniformly random OTHER class — together they set the offline
+    F1 ceiling below 1.0, which the default easy regime
+    (center_scale=1) never does.  NOTE: draw train and test in ONE call
+    and split — different seeds draw different class centers.
+    """
     rng = np.random.default_rng(seed)
-    centers = rng.normal(scale=1.0, size=(num_classes, num_features))
+    centers = rng.normal(scale=1.0,
+                         size=(num_classes, num_features)) * center_scale
     y = rng.integers(1, num_classes + 1, size=rows).astype(np.int32)
     x = centers[y - 1] + rng.normal(scale=noise, size=(rows, num_features))
     # zero out a fraction of entries: the reference's hashed-feature CSVs
     # are sparse and the producer drops zeros (CsvProducer.java:52-57)
     drop = rng.random(size=x.shape) < sparsity
     x = np.where(drop, 0.0, x).astype(np.float32)
+    if label_noise > 0.0:
+        flip = rng.random(rows) < label_noise
+        shift = rng.integers(1, num_classes, size=rows)
+        y = np.where(flip, (y - 1 + shift) % num_classes + 1,
+                     y).astype(np.int32)
     return x, y
+
+
+# The "hard" benchmark regime: class overlap tuned so an offline LR
+# ceiling lands at weighted F1 well below 1.0 at the reference's shapes
+# (1024 features, 5 classes) — the non-separable setting the reference's
+# headline numbers live on (offline 0.47 / best streaming 0.4482,
+# README.md:223-233,277).  Measured ceiling (sklearn LogisticRegression,
+# unpenalized) grows with training rows: 0.542 on a 5k-row fit, 0.642 on
+# the 12k-row campaign dataset (docs/EVALUATION.md).
+HARD_CENTER_SCALE = 0.2
+HARD_LABEL_NOISE = 0.0
+
+
+def generate_hard(rows: int, num_features: int = 1024,
+                  num_classes: int = 5,
+                  seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """The hard regime with default noise/sparsity (see HARD_* above)."""
+    return generate(rows, num_features, num_classes,
+                    seed=seed, center_scale=HARD_CENTER_SCALE,
+                    label_noise=HARD_LABEL_NOISE)
 
 
 def write_csv(path: str, x: np.ndarray, y: np.ndarray) -> None:
@@ -52,10 +87,20 @@ def main(argv=None) -> int:
     p.add_argument("--num_classes", type=int, default=5)
     p.add_argument("--noise", type=float, default=2.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--center_scale", type=float, default=1.0)
+    p.add_argument("--label_noise", type=float, default=0.0)
+    p.add_argument("--hard", action="store_true",
+                   help="non-separable benchmark regime (offline F1 "
+                        "ceiling ~0.54, see generate_hard)")
     args = p.parse_args(argv)
+    if args.hard:
+        args.center_scale = HARD_CENTER_SCALE
+        args.label_noise = HARD_LABEL_NOISE
     os.makedirs(args.out_dir, exist_ok=True)
     x, y = generate(args.rows + args.test_rows, args.num_features,
-                    args.num_classes, noise=args.noise, seed=args.seed)
+                    args.num_classes, noise=args.noise, seed=args.seed,
+                    center_scale=args.center_scale,
+                    label_noise=args.label_noise)
     write_csv(os.path.join(args.out_dir, "train.csv"),
               x[:args.rows], y[:args.rows])
     write_csv(os.path.join(args.out_dir, "test.csv"),

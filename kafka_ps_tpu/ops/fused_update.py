@@ -22,6 +22,15 @@ Workloads whose working set exceeds the VMEM budget (see fits_in_vmem:
 x + weight-shaped tensors + activations) fall back to the XLA path in
 models/logreg — at the reference's shapes (B≤1024, F=1024, C=5) the
 whole problem fits on-chip.
+
+Measured A/B (bench.py, interleaved pipelined dispatch, TPU v5e,
+B=1024 F=1024 k=2, BENCH_r02): 926 pallas vs 907 XLA local-updates/s —
+**1.02x, i.e. parity**.  SURVEY §7 predicted this: at 6150 parameters
+XLA already fuses the whole k-step loop well, so the kernel earns its
+keep only as the explicit-VMEM-residency form of the op (single
+pallas_call holding the solver loop on-chip) for shapes near the VMEM
+boundary, not as a speedup at reference scale.  The default path stays
+XLA (`--pallas` opts in).
 """
 
 from __future__ import annotations

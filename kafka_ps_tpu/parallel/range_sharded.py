@@ -136,5 +136,7 @@ def make_range_sharded_step(cfg: ModelConfig, num_workers: int,
 
 def unshard_theta(theta_padded, layout) -> np.ndarray:
     """Back to the host-side flat layout (drops the shard padding).
-    `layout` as in padded_num_params."""
-    return np.asarray(theta_padded)[:layout.num_params]
+    `layout` as in padded_num_params.  Returns a WRITABLE copy — the
+    server's message path mutates theta in place (runtime/server.py),
+    and an asarray view of a JAX array is read-only."""
+    return np.array(theta_padded[:layout.num_params])

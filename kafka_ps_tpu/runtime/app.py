@@ -271,20 +271,43 @@ class StreamingPSApp:
     def run_fused_bsp(self, max_server_iterations: int, mesh=None,
                       log_metrics: bool = True) -> None:
         """Sequential consistency as fused shard_map steps.  Each step is
-        one full BSP iteration (all workers advance one clock)."""
+        one full BSP iteration (all workers advance one clock).
+
+        A 2-D mesh (workers x params axes, parallel/mesh.worker_param_mesh)
+        selects the range-sharded server: parameters sharded over the
+        params axis (the reference's latent KeyRange design,
+        messages/KeyRange.java), all_gather pull / psum-slice push
+        (parallel/range_sharded.py).  Single-process only.
+        """
         import jax
         import jax.numpy as jnp
 
+        from kafka_ps_tpu.parallel import range_sharded
+        from kafka_ps_tpu.parallel.mesh import PARAM_AXIS
+
         if self.cfg.consistency_model != SEQUENTIAL:
             raise ValueError("fused path implements the sequential model only")
+        range_mode = mesh is not None and PARAM_AXIS in mesh.shape
+        if range_mode and jax.process_count() > 1:
+            raise ValueError(
+                "range-sharded fused mode is single-process (the params "
+                "axis would need a per-host theta-shard assembly)")
         # membership-aware: only active workers participate (a restored
         # checkpoint may carry evictions; their buffers are starved by
         # the data reroute and their tracker slots must stay frozen)
         active = self.server.tracker.active_workers
-        step = bsp.make_bsp_step(self.cfg.model, len(active),
-                                 self.cfg.server_lr, mesh=mesh,
-                                 task=self.server.task)
-        theta = jnp.asarray(self.server.theta)
+        task = self.server.task
+        if range_mode:
+            step = range_sharded.make_range_sharded_step(
+                self.cfg.model, len(active), self.cfg.server_lr, mesh,
+                task=task)
+            theta = range_sharded.shard_theta(
+                mesh, jnp.asarray(self.server.theta), task)
+        else:
+            step = bsp.make_bsp_step(self.cfg.model, len(active),
+                                     self.cfg.server_lr, mesh=mesh,
+                                     task=task)
+            theta = jnp.asarray(self.server.theta)
         # under BSP all active clocks are uniform; resume from the
         # restored one
         clock = min(self.server.tracker.clocks[w] for w in active)
@@ -340,6 +363,9 @@ class StreamingPSApp:
                     from kafka_ps_tpu.parallel import multihost
                     x, y, mask = multihost.shard_worker_batches_global(
                         mesh, x, y, mask)
+                elif range_mode:
+                    x, y, mask = range_sharded.shard_worker_batches(
+                        mesh, x, y, mask)
                 elif mesh is not None:
                     x, y, mask = bsp.shard_worker_batches(mesh, x, y, mask)
                 else:
@@ -357,14 +383,22 @@ class StreamingPSApp:
             self.server.iterations += len(active)
             # np.array (copy): an asarray view of a JAX array is
             # read-only and the message path mutates theta in place
-            self.server.theta = np.array(theta)
+            if range_mode:
+                self.server.theta = range_sharded.unshard_theta(theta, task)
+            else:
+                self.server.theta = np.array(theta)
             for w in active:
                 self.workers[w].iterations += 1
                 self.server.tracker.tracker[w].vector_clock = clock
                 self.server.tracker.tracker[w].weights_message_sent = True
             self.server.maybe_checkpoint()
-            if log_metrics and self.server.test_x is not None:
-                m = self.server.task.evaluate(theta, self.server.test_x,
+            if (log_metrics and self.server.test_x is not None
+                    and clock % self.cfg.eval_every == 0):
+                # range mode: theta is the padded sharded vector; eval on
+                # the reassembled flat layout (just stored on the server)
+                eval_theta = (jnp.asarray(self.server.theta) if range_mode
+                              else theta)
+                m = self.server.task.evaluate(eval_theta, self.server.test_x,
                                               self.server.test_y)
                 self.server.last_metrics = m
                 now = int(time.time() * 1000)

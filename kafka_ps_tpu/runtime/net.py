@@ -1,0 +1,285 @@
+"""Socket transport — the cross-host hop for the ASYNC consistency
+models (bounded delay / eventual), carrying the binary serde frames
+(runtime/serde.py) over TCP.
+
+This is the last Kafka property with no in-process counterpart: the
+reference's server JVM and worker JVMs exchange WEIGHTS / GRADIENTS /
+INPUT_DATA through the broker from different machines
+(kubernetes/server.yaml + worker.yaml, broker kafka:9092).  The fused
+BSP path scales out through jax.distributed collectives instead
+(parallel/multihost.py) — but the async modes are host-orchestrated by
+design, so their multi-host story is exactly this: a server process
+(aggregator + consistency gate + producer) and worker processes
+(buffers + local solvers), point-to-point sockets in place of topics.
+
+Wire format, little-endian:
+    frame  := <u32 length> <u8 topic> <i64 key> <payload>
+    topic  := 1 WEIGHTS | 2 GRADIENTS | 3 INPUT_DATA | 4 HELLO | 5 READY
+    payload:= serde.to_bytes(message)   (HELLO: <i64 n> <i64 ids[n]>;
+                                         READY: empty)
+`key` is the logical worker id (the Kafka record key, CsvProducer.java:61).
+
+Delivery properties preserved from the reference fabric: addressed
+per-worker delivery, per-connection FIFO (TCP), asynchronous buffering
+(the consistency gate never blocks on a send).  Cites:
+ServerProcessor.java:172-182 (weights send), WorkerTrainingProcessor
+.java:95-97 (gradient send, record key 0), CsvProducer.java:61-65.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime import serde
+
+_FRAME = struct.Struct("<IBq")          # length, topic, key
+T_WEIGHTS, T_GRADIENTS, T_DATA, T_HELLO, T_READY = 1, 2, 3, 4, 5
+_TOPIC_NAMES = {T_WEIGHTS: fabric_mod.WEIGHTS_TOPIC,
+                T_GRADIENTS: fabric_mod.GRADIENTS_TOPIC,
+                T_DATA: fabric_mod.INPUT_DATA_TOPIC}
+
+
+def send_frame(sock: socket.socket, topic: int, key: int,
+               payload: bytes = b"") -> None:
+    header = _FRAME.pack(_FRAME.size - 4 + len(payload), topic, key)
+    sock.sendall(header + payload)
+
+
+def recv_frame(sock: socket.socket) -> tuple[int, int, bytes] | None:
+    """(topic, key, payload) or None on a clean EOF."""
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (length,) = struct.unpack("<I", head)
+    body = _recv_exact(sock, length)
+    if body is None:
+        raise ConnectionError("mid-frame EOF")
+    topic, key = struct.unpack_from("<Bq", body, 0)
+    return topic, key, body[9:]
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None if not buf else None
+        buf += chunk
+    return buf
+
+
+class ServerBridge:
+    """Server-process side: listens for worker processes, forwards
+    WEIGHTS / INPUT_DATA to the connection owning each worker key, and
+    delivers incoming GRADIENTS into the local fabric's gather queue.
+
+    Install via `bridge.wrap(fabric)`: the returned fabric routes sends
+    addressed to remote workers over their socket and leaves local
+    behavior untouched (the Kafka-broker role, minus the broker).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._listener = socket.create_server((host, port))
+        self.port = self._listener.getsockname()[1]
+        self._conn_of: dict[int, socket.socket] = {}   # worker -> conn
+        self._ready: set[int] = set()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._fabric: fabric_mod.Fabric | None = None
+        self._stop = threading.Event()
+        self._send_lock: dict[socket.socket, threading.Lock] = {}
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="kps-net-accept").start()
+
+    # -- fabric integration ------------------------------------------------
+
+    def wrap(self, fabric: fabric_mod.Fabric) -> fabric_mod.Fabric:
+        bridge = self
+
+        class BridgedFabric(fabric_mod.Fabric):
+            def send(self, topic, key, message):
+                conn = bridge._conn_of.get(key) \
+                    if topic == fabric_mod.WEIGHTS_TOPIC else None
+                if conn is not None:
+                    bridge._send(conn, T_WEIGHTS, key, message)
+                else:
+                    super().send(topic, key, message)
+
+        out = BridgedFabric()
+        # share state with the original so pre-wrap queues stay visible
+        out._queues = fabric._queues
+        out._cond = fabric._cond
+        out._tracer = fabric._tracer
+        self._fabric = out
+        return out
+
+    def send_data(self, worker: int, features: dict[int, float],
+                  label: int) -> bool:
+        """Forward one stream row to the process hosting `worker`.
+        False if that worker is not (yet) connected."""
+        from kafka_ps_tpu.runtime.messages import LabeledData
+        conn = self._conn_of.get(worker)
+        if conn is None:
+            return False
+        self._send(conn, T_DATA, worker, LabeledData(features, label))
+        return True
+
+    def wait_for_connected(self, workers, timeout: float = 60.0) -> None:
+        """Block until every worker id has a connection (HELLO seen) —
+        before this the producer has nowhere to send their rows."""
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: all(w in self._conn_of for w in workers),
+                timeout=timeout)
+        if not ok:
+            missing = [w for w in workers if w not in self._conn_of]
+            raise TimeoutError(f"workers {missing} not connected in time")
+
+    def wait_for_workers(self, workers, timeout: float = 60.0) -> None:
+        """Block until every worker id has reported READY (its buffer
+        holds data) — the actual invariant behind the reference's fixed
+        20 s bootstrap sleep (ServerAppRunner.java:95)."""
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: all(w in self._ready for w in workers),
+                timeout=timeout)
+        if not ok:
+            missing = [w for w in workers if w not in self._ready]
+            raise TimeoutError(f"workers {missing} not ready in time")
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for conn in list(self._conn_of.values()):
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- internals ---------------------------------------------------------
+
+    def _send(self, conn, topic, key, message) -> None:
+        with self._send_lock[conn]:
+            send_frame(conn, topic, key, serde.to_bytes(message))
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._send_lock[conn] = threading.Lock()
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True, name="kps-net-reader").start()
+
+    def _reader(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                frame = recv_frame(conn)
+                if frame is None:
+                    return
+                topic, key, payload = frame
+                if topic == T_HELLO:
+                    (n,) = struct.unpack_from("<q", payload, 0)
+                    ids = struct.unpack_from(f"<{n}q", payload, 8)
+                    with self._cv:
+                        for w in ids:
+                            self._conn_of[w] = conn
+                        self._cv.notify_all()
+                elif topic == T_READY:
+                    with self._cv:
+                        self._ready.add(key)
+                        self._cv.notify_all()
+                elif topic == T_GRADIENTS and self._fabric is not None:
+                    self._fabric.send(fabric_mod.GRADIENTS_TOPIC, 0,
+                                      serde.from_bytes(payload))
+        except (ConnectionError, OSError):
+            return
+
+
+class WorkerBridge:
+    """Worker-process side: connects to the server, registers its
+    logical worker ids, feeds received INPUT_DATA rows into the local
+    buffers, delivers received WEIGHTS into the local fabric, and routes
+    the workers' GRADIENTS sends back over the socket."""
+
+    def __init__(self, host: str, port: int, worker_ids: list[int],
+                 connect_timeout: float = 30.0):
+        self.worker_ids = list(worker_ids)
+        # retry: the server process may still be importing/binding when
+        # this process is already up (both launched together, run.sh-style)
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=5.0)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._stop = threading.Event()
+        self.disconnected = threading.Event()
+        payload = struct.pack(f"<q{len(self.worker_ids)}q",
+                              len(self.worker_ids), *self.worker_ids)
+        with self._send_lock:
+            send_frame(self._sock, T_HELLO, 0, payload)
+
+    def make_fabric(self) -> fabric_mod.Fabric:
+        """Local fabric whose GRADIENTS sends cross the socket (the
+        worker's view of the broker)."""
+        bridge = self
+
+        class BridgedFabric(fabric_mod.Fabric):
+            def send(self, topic, key, message):
+                if topic == fabric_mod.GRADIENTS_TOPIC:
+                    with bridge._send_lock:
+                        send_frame(bridge._sock, T_GRADIENTS, key,
+                                   serde.to_bytes(message))
+                else:
+                    super().send(topic, key, message)
+
+        self.fabric = BridgedFabric()
+        return self.fabric
+
+    def mark_ready(self, worker: int) -> None:
+        with self._send_lock:
+            send_frame(self._sock, T_READY, worker)
+
+    def run_reader(self, buffers: dict[int, object]) -> None:
+        """Blocking read loop (call on a dedicated thread or the main
+        thread): dispatches INPUT_DATA to `buffers[worker].add` and
+        WEIGHTS into the local fabric.  Returns on EOF (server done)."""
+        try:
+            while not self._stop.is_set():
+                frame = recv_frame(self._sock)
+                if frame is None:
+                    break
+                topic, key, payload = frame
+                msg = serde.from_bytes(payload)
+                if topic == T_DATA:
+                    buffers[key].add(msg.features, msg.label)
+                elif topic == T_WEIGHTS:
+                    self.fabric.send(fabric_mod.WEIGHTS_TOPIC, key, msg)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.disconnected.set()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass

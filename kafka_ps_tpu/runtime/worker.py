@@ -94,8 +94,13 @@ class WorkerNode:
 
         # Post-fit test metrics, like the reference's per-iteration eval
         # inside calculateGradients (LogisticRegressionTaskSpark.java:186).
+        # eval_every > 1 skips the (wall-clock-dominating) full-test-set
+        # evaluation on off-cadence clocks, logging the reference's own
+        # "-1 = not computed" placeholder (ServerProcessor.java:158-164
+        # uses it for loss).
         f1, acc = -1.0, -1.0
-        if self.test_x is not None:
+        if (self.test_x is not None
+                and msg.vector_clock % self.cfg.eval_every == 0):
             m = self.task.evaluate(jnp.asarray(self.theta + delta),
                                    self.test_x, self.test_y)
             f1, acc = float(m.f1), float(m.accuracy)

@@ -32,15 +32,12 @@ def _free_port() -> int:
 
 
 def _write_csvs(tmp_path, num_features=16, num_classes=3):
-    from kafka_ps_tpu.data.synth import generate
-    header = ",".join(map(str, range(num_features))) + ",Score"
+    from kafka_ps_tpu.data.synth import generate, write_csv
     # one draw, then split: train and test must share class geometry
     x, y = generate(390, num_features, num_classes, noise=1.0,
                     sparsity=0.5, seed=0)
-    np.savetxt(tmp_path / "train.csv", np.column_stack([x[:300], y[:300]]),
-               delimiter=",", header=header, comments="")
-    np.savetxt(tmp_path / "test.csv", np.column_stack([x[300:], y[300:]]),
-               delimiter=",", header=header, comments="")
+    write_csv(str(tmp_path / "train.csv"), x[:300], y[:300])
+    write_csv(str(tmp_path / "test.csv"), x[300:], y[300:])
 
 
 def _launch(tmp_path, port: int, pid: int, nprocs: int,

@@ -1,0 +1,108 @@
+"""Regression: our evaluation pipeline run on the REFERENCE's committed
+logs must reproduce every derived number in BASELINE.md / SURVEY §6.
+
+The reference's regression record is `evaluation/logs/*.csv` (8 run
+configs, March 2020, analyzed by its notebooks).  Loading those exact
+files through evaluation/logs.py and recovering the published stats
+proves "the notebooks work unchanged on our logs" in both directions:
+same schema, same derivations.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.evaluation import logs
+
+REF_LOGS = "/root/reference/evaluation/logs"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF_LOGS), reason="reference checkout not present")
+
+
+def _summary(run: str) -> logs.RunSummary:
+    s = logs.load_server_log(f"{REF_LOGS}/{run}_logs-server.csv")
+    w = logs.load_worker_log(f"{REF_LOGS}/{run}_logs-worker.csv")
+    return logs.summarize_run(s, w)
+
+
+def test_4w_10tps_headline_numbers():
+    """The reference's strongest published configuration
+    (README.md:277, BASELINE.md): best F1 0.4482, best acc 0.4609,
+    F1>=0.40 at 124 s, F1>=0.44 at 246 s."""
+    su = _summary("4-workers_10tps")
+    assert su.best_f1 == pytest.approx(0.4482, abs=5e-4)
+    assert su.best_accuracy == pytest.approx(0.4609, abs=5e-4)
+    assert su.secs_to_f1[0.40] == pytest.approx(124.0, abs=1.0)
+    assert su.secs_to_f1[0.44] == pytest.approx(246.0, abs=1.0)
+
+
+@pytest.mark.parametrize("run,best_f1,iters,ips", [
+    ("4-workers_5tps", 0.4399, 179, 0.35),
+    ("4-workers_2-5tps", 0.4292, 468, 0.42),
+    ("single-worker_5tps", 0.3841, 803, 0.76),
+    ("sequential", 0.4183, 495, 0.25),
+    ("bounded_delay_10", 0.4143, 507, 0.27),
+    ("eventual", 0.4122, 712, 0.36),
+])
+def test_published_run_stats(run, best_f1, iters, ips):
+    """Best F1 / iteration counts / server iters-per-sec for every
+    committed run config (SURVEY §6 table; iters within the +-1 the
+    survey's maxVC-vs-row-count convention allows)."""
+    su = _summary(run)
+    assert su.best_f1 == pytest.approx(best_f1, abs=5e-4)
+    assert abs(su.iterations - iters) <= 1
+    assert su.iters_per_sec == pytest.approx(ips, abs=0.01)
+
+
+def test_server_iters_per_sec_span():
+    """BASELINE.md: the reference's server loop runs 0.18-0.76 iters/s
+    across all committed configs — the band our TPU loop must beat."""
+    runs = ["4-workers_10tps", "4-workers_5tps", "4-workers_2-5tps",
+            "single-worker_5tps", "sequential", "bounded_delay_10",
+            "eventual"]
+    ips = [_summary(r).iters_per_sec for r in runs]
+    assert min(ips) == pytest.approx(0.184, abs=0.01)
+    assert max(ips) == pytest.approx(0.762, abs=0.01)
+
+
+def test_consistency_models_clock_spread_at_bound():
+    """The protocol story of README.md:293-323 in one metric: the
+    fastest-slowest worker clock gap is 0 under sequential, <=10 under
+    bounded delay 10 (and reaches it), ~20 under eventual."""
+    spreads = {}
+    for run in ["sequential", "bounded_delay_10", "eventual"]:
+        w = logs.load_worker_log(f"{REF_LOGS}/{run}_logs-worker.csv")
+        spreads[run] = logs.worker_clock_spread(w)["spread"].max()
+    assert spreads["sequential"] == 0
+    assert spreads["bounded_delay_10"] == 10
+    assert spreads["eventual"] == 21          # README: "approximately 20"
+    assert (spreads["sequential"] < spreads["bounded_delay_10"]
+            < spreads["eventual"])
+
+
+def test_sequential_is_least_volatile():
+    """README.md:293: sequential shows the least F1 volatility.  (The
+    reference's qualitative bounded-vs-eventual ordering is not
+    reproducible from its own committed logs under std-of-diffs — noted
+    in docs/EVALUATION.md — but sequential-least is robust under every
+    variant.)"""
+    vol = {}
+    for run in ["sequential", "bounded_delay_10", "eventual"]:
+        s = logs.load_server_log(f"{REF_LOGS}/{run}_logs-server.csv")
+        vol[run] = float(np.std(np.diff(s["fMeasure"])))
+    assert vol["sequential"] < vol["bounded_delay_10"]
+    assert vol["sequential"] < vol["eventual"]
+
+
+def test_worker_updates_per_sec_band():
+    """BASELINE.md: 0.73-1.85 aggregate worker updates/s across the
+    committed 4-worker configs."""
+    wups = [_summary(r).worker_updates_per_sec
+            for r in ["4-workers_10tps", "4-workers_5tps",
+                      "4-workers_2-5tps", "sequential",
+                      "bounded_delay_10", "eventual"]]
+    assert 0.7 <= min(wups) and max(wups) <= 1.9

@@ -1,0 +1,135 @@
+"""Split server/worker deployment over the socket transport
+(cli/socket_mode.py, runtime/net.py): two REAL processes exchanging
+WEIGHTS / GRADIENTS / INPUT_DATA as binary serde frames — the
+reference's separate-JVM topology, and the multi-host story for the
+async consistency models (VERDICT r1 item 9).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env() -> dict:
+    env = dict(os.environ)
+    env["KPS_PLATFORM"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _write_csvs(tmp_path):
+    from kafka_ps_tpu.data.synth import generate, write_csv
+    x, y = generate(460, 16, 3, noise=1.0, sparsity=0.5, seed=0)
+    write_csv(str(tmp_path / "train.csv"), x[:400], y[:400])
+    write_csv(str(tmp_path / "test.csv"), x[400:], y[400:])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("consistency", [10, -1])
+def test_split_deployment_bounded_and_eventual(tmp_path, consistency):
+    _write_csvs(tmp_path)
+    port = _free_port()
+    server_dir = tmp_path / "server"
+    worker_dir = tmp_path / "worker"
+    server_dir.mkdir(), worker_dir.mkdir()
+
+    common = ["-test", "../test.csv", "--num_features", "16",
+              "--num_classes", "3", "--num_workers", "4", "-l"]
+    server = subprocess.Popen(
+        [sys.executable, "-m", "kafka_ps_tpu.cli.server_runner",
+         "--listen", str(port), "-training", "../train.csv",
+         "-c", str(consistency), "-p", "1", "--max_iterations", "60"]
+        + common,
+        cwd=server_dir, env=_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "kafka_ps_tpu.cli.worker_runner",
+         "--connect", f"127.0.0.1:{port}", "--worker_ids", "0,1,2,3"]
+        + common,
+        cwd=worker_dir, env=_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+    for proc, name in [(server, "server"), (worker, "worker")]:
+        try:
+            out, err = proc.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            server.kill(), worker.kill()
+            pytest.fail(f"{name} process hung")
+        assert proc.returncode == 0, \
+            f"{name} failed (rc={proc.returncode}):\n{out[-1500:]}\n{err[-3000:]}"
+
+    sdf = pd.read_csv(server_dir / "logs-server.csv", sep=";")
+    wdf = pd.read_csv(worker_dir / "logs-worker.csv", sep=";")
+    assert len(sdf) >= 10            # worker 0 reported >= 10 clocks
+    assert set(wdf["partition"]) == {0, 1, 2, 3}
+    assert wdf["vectorClock"].max() >= 10
+
+    # the consistency contract holds across the process boundary
+    from kafka_ps_tpu.evaluation import validate
+    violations = validate.validate_run(wdf, sdf,
+                                       consistency_model=consistency)
+    assert violations == []
+
+    # the system actually learned through the socket hop
+    assert sdf["fMeasure"].max() > 0.5
+
+
+@pytest.mark.slow
+def test_split_deployment_two_worker_processes(tmp_path):
+    """Workers split across TWO processes (the reference's N-worker-pod
+    shape), sequential consistency."""
+    _write_csvs(tmp_path)
+    port = _free_port()
+    dirs = {n: tmp_path / n for n in ("server", "w0", "w1")}
+    for d in dirs.values():
+        d.mkdir()
+    common = ["-test", "../test.csv", "--num_features", "16",
+              "--num_classes", "3", "--num_workers", "4", "-l"]
+    procs = {
+        "server": subprocess.Popen(
+            [sys.executable, "-m", "kafka_ps_tpu.cli.server_runner",
+             "--listen", str(port), "-training", "../train.csv",
+             "-c", "0", "-p", "1", "--max_iterations", "40"] + common,
+            cwd=dirs["server"], env=_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True),
+    }
+    for i, ids in [(0, "0,1"), (1, "2,3")]:
+        procs[f"w{i}"] = subprocess.Popen(
+            [sys.executable, "-m", "kafka_ps_tpu.cli.worker_runner",
+             "--connect", f"127.0.0.1:{port}", "--worker_ids", ids]
+            + common,
+            cwd=dirs[f"w{i}"], env=_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+
+    for name, proc in procs.items():
+        try:
+            out, err = proc.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for p in procs.values():
+                p.kill()
+            pytest.fail(f"{name} hung")
+        assert proc.returncode == 0, \
+            f"{name} failed:\n{out[-1500:]}\n{err[-3000:]}"
+
+    w0 = pd.read_csv(dirs["w0"] / "logs-worker.csv", sep=";")
+    w1 = pd.read_csv(dirs["w1"] / "logs-worker.csv", sep=";")
+    assert set(w0["partition"]) == {0, 1}
+    assert set(w1["partition"]) == {2, 3}
+    from kafka_ps_tpu.evaluation import validate
+    sdf = pd.read_csv(dirs["server"] / "logs-server.csv", sep=";")
+    assert validate.validate_run(pd.concat([w0, w1]), sdf, 0) == []
