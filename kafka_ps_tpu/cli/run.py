@@ -14,6 +14,7 @@ are prefixed with `--`-only long names.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 import numpy as np
@@ -188,7 +189,6 @@ def apply_platform_env() -> None:
     job).  Must happen before first backend use; a plain JAX_PLATFORMS
     env var can be overridden by accelerator plugins at interpreter
     start."""
-    import os
     platform = os.environ.get("KPS_PLATFORM")
     if platform:
         import jax
