@@ -313,6 +313,11 @@ def run_with_args(args) -> int:
         if args.checkpoint and process_index == 0:
             from kafka_ps_tpu.utils import checkpoint as ckpt
             ckpt.save(args.checkpoint, app.server)
+        if (args.logging and process_index == 0
+                and app.server.membership_events):
+            from kafka_ps_tpu.cli.socket_mode import write_events_log
+            write_events_log("./logs-events.csv",
+                             app.server.membership_events)
         for log in logs:
             log.close()
         if args.trace:

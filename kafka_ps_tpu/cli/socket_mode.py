@@ -20,6 +20,7 @@ delay and eventual consistency have a real multi-host story too.
 
 from __future__ import annotations
 
+import queue
 import sys
 import threading
 import time
@@ -28,6 +29,18 @@ import numpy as np
 
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime import net
+
+EVENTS_HEADER = "timestamp;event;partition"
+
+
+def write_events_log(path: str, events) -> None:
+    """Persist the server's membership-change record (the eviction /
+    readmission audit trail the staleness auditor segments elastic runs
+    by, evaluation/validate.py)."""
+    with open(path, "w") as fh:
+        fh.write(EVENTS_HEADER + "\n")
+        for ts, kind, worker in events:
+            fh.write(f"{ts};{kind};{worker}\n")
 
 
 def _make_cfg(args):
@@ -58,27 +71,78 @@ def _make_cfg(args):
 
 
 def run_server(args) -> int:
-    """Server role: ServerNode + producer, all workers remote."""
+    """Server role: ServerNode + producer, all workers remote.
+
+    Failure handling mirrors the in-process supervisor
+    (runtime/app.py:run_threaded) across the wire — the reference gets
+    the same from Kafka consumer-group rebalancing + k8s pod restarts
+    (kubernetes/worker.yaml, SURVEY §5):
+      * failure_policy=halt (default): a worker-connection loss stops
+        the run with an error instead of deadlocking the gate;
+      * failure_policy=rebalance: the dead connection's workers are
+        evicted (gates stop waiting, their stream rows reroute to the
+        survivors) and a reconnecting worker process is readmitted at
+        the slowest active clock once its buffer holds data (READY).
+    """
     from kafka_ps_tpu.cli.run import load_test_csv
     from kafka_ps_tpu.data.stream import CsvStreamProducer
     from kafka_ps_tpu.runtime.server import ServerNode
     from kafka_ps_tpu.utils.csvlog import CsvLogSink, SERVER_HEADER
 
     cfg = _make_cfg(args)
+    failure_policy = getattr(args, "failure_policy", "halt")
+    hb_timeout = getattr(args, "heartbeat_timeout", None)
     test_x, test_y = load_test_csv(args.test_data_file_path,
                                    args.num_features)
     log = CsvLogSink("./logs-server.csv" if args.logging else None,
                      SERVER_HEADER)
-    bridge = net.ServerBridge(port=args.listen)
+    bridge = net.ServerBridge(
+        port=args.listen,
+        heartbeat_interval=min(1.0, hb_timeout / 3) if hb_timeout else 1.0,
+        heartbeat_timeout=hb_timeout)
     print(f"listening on port {bridge.port}", file=sys.stderr, flush=True)
     fabric = bridge.wrap(fabric_mod.Fabric())
     server = ServerNode(cfg, fabric, test_x, test_y, log)
 
-    workers = list(range(cfg.num_workers))
+    checkpoint_path = getattr(args, "checkpoint", None)
+    resuming = False
+    if checkpoint_path:
+        from kafka_ps_tpu.utils import checkpoint as ckpt
+        resuming = ckpt.maybe_restore(checkpoint_path, server)
+        server.checkpoint_path = checkpoint_path
+        server.checkpoint_every = getattr(args, "checkpoint_every", 50)
+        if resuming:
+            print(f"restored checkpoint at iteration {server.iterations}",
+                  file=sys.stderr, flush=True)
+
+    # membership events cross threads (bridge readers -> main loop):
+    # ServerNode is single-threaded by design, so evictions/readmissions
+    # are applied only between gradient polls
+    events: "queue.Queue[tuple[str, object]]" = queue.Queue()
+    bridge.on_disconnect = lambda ids: events.put(("disconnect", ids))
+    bridge.on_ready = lambda w: events.put(("ready", w))
+
+    workers = server.tracker.active_workers   # a checkpoint may carry evictions
     bridge.wait_for_connected(workers, timeout=args.connect_timeout)
 
+    reroute = {"rr": 0, "dropped": 0}
+
     def sink(worker: int, features: dict[int, float], label: int) -> None:
-        bridge.send_data(worker, features, label)
+        # Rows flow to whoever holds the worker's connection — including
+        # a reconnected-but-not-yet-readmitted process (its buffer must
+        # fill before READY triggers readmission).  A dead target
+        # reroutes round-robin to the survivors (the partition
+        # reassignment of a consumer-group rebalance); with nobody left
+        # the row is counted, not silently discarded.
+        if bridge.send_data(worker, features, label):
+            return
+        active = server.tracker.active_workers
+        for _ in range(len(active)):
+            alt = active[reroute["rr"] % len(active)]
+            reroute["rr"] += 1
+            if alt != worker and bridge.send_data(alt, features, label):
+                return
+        reroute["dropped"] += 1
 
     producer = CsvStreamProducer(
         args.training_data_file_path, cfg.num_workers, sink,
@@ -87,16 +151,57 @@ def run_server(args) -> int:
     producer.run_in_background()
     bridge.wait_for_workers(workers, timeout=args.connect_timeout)
 
+    def apply_events() -> None:
+        while True:
+            try:
+                kind, val = events.get_nowait()
+            except queue.Empty:
+                return
+            if kind == "disconnect":
+                live = [w for w in val
+                        if server.tracker.tracker[w].active]
+                if not live:
+                    continue
+                if failure_policy == "halt":
+                    raise RuntimeError(
+                        f"worker connection lost for {sorted(live)} "
+                        "(failure_policy=halt; use "
+                        "--failure_policy rebalance to continue on "
+                        "the survivors)")
+                for w in live:
+                    try:
+                        server.remove_worker(w)
+                    except ValueError:
+                        raise RuntimeError(
+                            "all worker connections lost") from None
+                    print(f"evicted worker {w} (connection lost)",
+                          file=sys.stderr, flush=True)
+            elif kind == "ready" and failure_policy == "rebalance":
+                w = int(val)
+                if not server.tracker.tracker[w].active:
+                    clock = server.readmit_worker(w)
+                    print(f"readmitted worker {w} at clock {clock}",
+                          file=sys.stderr, flush=True)
+
     server.start_training_loop()
     max_iters = args.max_iterations or sys.maxsize
     try:
         while server.iterations < max_iters:
+            apply_events()
             g = fabric.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0,
                                      timeout=0.2)
             if g is not None:
                 server.process(g)
     finally:
         bridge.close()       # workers see EOF and shut down
+        if checkpoint_path:
+            from kafka_ps_tpu.utils import checkpoint as ckpt
+            ckpt.save(checkpoint_path, server)
+        if reroute["dropped"] or bridge.dropped_sends:
+            print(f"dropped rows: {reroute['dropped']}, dropped sends: "
+                  f"{bridge.dropped_sends}", file=sys.stderr, flush=True)
+        if args.logging and server.membership_events:
+            write_events_log("./logs-events.csv", server.membership_events)
         log.close()
     return 0
 
@@ -116,7 +221,9 @@ def run_worker(args) -> int:
     log = CsvLogSink("./logs-worker.csv" if args.logging else None,
                      WORKER_HEADER)
 
-    bridge = net.WorkerBridge(host or "127.0.0.1", int(port), ids)
+    bridge = net.WorkerBridge(
+        host or "127.0.0.1", int(port), ids,
+        heartbeat_timeout=getattr(args, "heartbeat_timeout", None))
     fabric = bridge.make_fabric()
     buffers = {w: SlidingBuffer(cfg.model.num_features, cfg.buffer)
                for w in ids}

@@ -51,9 +51,14 @@ def main(argv=None) -> int:
     s.add_argument("--server")
     s.add_argument("-c", "--consistency_model", type=int, default=0)
     s.add_argument("--elastic", action="store_true",
-                   help="run used failure_policy=rebalance: check clock "
-                        "monotonicity only (membership changes void the "
-                        "static staleness bound)")
+                   help="run used failure_policy=rebalance; with "
+                        "--events the full contract is re-derived per "
+                        "membership epoch, without it only clock "
+                        "monotonicity is checked")
+    s.add_argument("--events", metavar="logs-events.csv",
+                   help="the server's membership-change record "
+                        "(timestamp;event;partition) — written by split-"
+                        "mode runs with -l (cli/socket_mode.py)")
 
     s = sub.add_parser("ground-truth")
     s.add_argument("--train", required=True)
@@ -91,8 +96,12 @@ def main(argv=None) -> int:
             raise SystemExit("validate needs --worker and/or --server")
         wdf = logs_mod.load_worker_log(args.worker) if args.worker else None
         sdf = logs_mod.load_server_log(args.server) if args.server else None
+        events = (validate.load_membership_events(args.events)
+                  if args.events else None)
         violations = validate.validate_run(wdf, sdf, args.consistency_model,
-                                           elastic=args.elastic)
+                                           elastic=args.elastic or
+                                           bool(events),
+                                           membership_events=events)
         for v in violations:
             print(f"VIOLATION [{v.rule}] {v.detail}")
         print(f"{len(violations)} violation(s)")

@@ -41,18 +41,38 @@ class Violation:
     detail: str
 
 
+MembershipEvent = tuple[int, str, int]    # (timestamp_ms, kind, worker)
+
+
 def validate_worker_log(worker_df: pd.DataFrame,
                         consistency_model: int,
-                        elastic: bool = False) -> list[Violation]:
+                        elastic: bool = False,
+                        membership_events: list[MembershipEvent] | None = None
+                        ) -> list[Violation]:
     """`elastic=True` validates a run with worker eviction/readmission
-    (failure_policy=rebalance): membership changes void the static
-    staleness bound (survivors legitimately run past an evicted
-    worker's frozen clock), so only per-worker clock monotonicity
-    (never a regression) is checked.  An *equal* clock across a rejoin
-    is legitimate: readmission joins at the min ACTIVE clock
-    (tracker.reactivate_worker), which equals the evicted worker's own
-    last logged clock when the survivors have not advanced yet."""
+    (failure_policy=rebalance).
+
+    With `membership_events` (the server's (timestamp_ms, "evict" |
+    "readmit", worker) record — ServerNode.membership_events, or the
+    logs-events.csv a split-mode server writes), the full contract is
+    re-derived PER MEMBERSHIP EPOCH instead of being skipped:
+
+      * per-worker clock step is exactly +1, except across that
+        worker's own readmission, where any value is legal (rejoin is
+        at the min ACTIVE clock, tracker.reactivate_worker — above,
+        equal to, or below the worker's own frozen clock);
+      * the k+1 staleness bound holds within every epoch over the
+        workers active in that epoch (an eviction removes the dead
+        worker's frozen clock from the spread; a readmission re-adds
+        the worker at a gate-legal clock).
+
+    Without events (legacy elastic call), only per-worker clock
+    monotonicity is checked — membership changes void the static bound
+    and nothing records where they happened."""
     out: list[Violation] = []
+    if elastic and membership_events is not None:
+        return _validate_elastic_epochs(worker_df, consistency_model,
+                                        membership_events)
     # 1. per-worker clocks
     for w, g in worker_df.groupby("partition"):
         clocks = g["vectorClock"].tolist()
@@ -84,6 +104,71 @@ def validate_worker_log(worker_df: pd.DataFrame,
     return out
 
 
+def _validate_elastic_epochs(worker_df: pd.DataFrame,
+                             consistency_model: int,
+                             membership_events: list[MembershipEvent]
+                             ) -> list[Violation]:
+    """Merge log rows and membership events into one timeline and audit
+    each epoch (the interval between two membership changes) against
+    the same contract a static run gets.  Events order before log rows
+    on timestamp ties: the server records the change before the
+    affected traffic flows."""
+    out: list[Violation] = []
+    bound = consistency_model + 1
+    check_bound = consistency_model != EVENTUAL
+
+    rows = worker_df.sort_values("timestamp", kind="stable")
+    timeline: list[tuple[int, int, object]] = []   # (ts, order, item)
+    for ev in sorted(membership_events, key=lambda e: e[0]):
+        timeline.append((int(ev[0]), 0, ev))
+    for _, row in rows.iterrows():
+        timeline.append((int(row["timestamp"]), 1,
+                         (int(row["partition"]), int(row["vectorClock"]))))
+    timeline.sort(key=lambda t: (t[0], t[1]))
+
+    active = {int(w) for w in worker_df["partition"].unique()}
+    active |= {int(w) for _, _, w in membership_events}
+    latest: dict[int, int] = {}         # last logged clock per worker
+    # workers whose NEXT log row follows their own readmission: the +1
+    # step check is suspended for exactly that one row
+    rejoined: set[int] = set()
+
+    for ts, kind_order, item in timeline:
+        if kind_order == 0:             # membership event
+            _, kind, w = item
+            w = int(w)
+            if kind == "evict":
+                active.discard(w)
+                latest.pop(w, None)     # frozen clock leaves the spread
+            else:                       # readmit
+                active.add(w)
+                rejoined.add(w)
+            continue
+        w, clock = item
+        prev = latest.get(w)
+        if w in rejoined:
+            rejoined.discard(w)
+        elif prev is not None and clock != prev + 1:
+            out.append(Violation(
+                "clock-step",
+                f"worker {w}: clock {prev} -> {clock} "
+                f"(expected {prev + 1}) at timestamp {ts}"))
+        if w not in active:
+            # last-gasp row from an evicted worker (in flight at the
+            # eviction): legal, but its frozen clock must not rejoin
+            # the spread
+            continue
+        latest[w] = clock
+        if check_bound and len(latest) > 1:
+            spread = max(latest.values()) - min(latest.values())
+            if spread > bound:
+                out.append(Violation(
+                    "staleness-bound",
+                    f"spread {spread} > bound {bound} at timestamp "
+                    f"{ts} (clocks {dict(sorted(latest.items()))})"))
+    return out
+
+
 def validate_server_log(server_df: pd.DataFrame) -> list[Violation]:
     out: list[Violation] = []
     clocks = server_df["vectorClock"].tolist()
@@ -98,11 +183,22 @@ def validate_server_log(server_df: pd.DataFrame) -> list[Violation]:
 def validate_run(worker_df: pd.DataFrame | None,
                  server_df: pd.DataFrame | None,
                  consistency_model: int,
-                 elastic: bool = False) -> list[Violation]:
+                 elastic: bool = False,
+                 membership_events: list[MembershipEvent] | None = None
+                 ) -> list[Violation]:
     out: list[Violation] = []
     if worker_df is not None and len(worker_df):
         out += validate_worker_log(worker_df, consistency_model,
-                                   elastic=elastic)
+                                   elastic=elastic,
+                                   membership_events=membership_events)
     if server_df is not None and len(server_df):
         out += validate_server_log(server_df)
     return out
+
+
+def load_membership_events(path: str) -> list[MembershipEvent]:
+    """Parse a logs-events.csv (`timestamp;event;partition`, written by
+    cli/socket_mode.write_events_log)."""
+    df = pd.read_csv(path, sep=";")
+    return [(int(r["timestamp"]), str(r["event"]), int(r["partition"]))
+            for _, r in df.iterrows()]

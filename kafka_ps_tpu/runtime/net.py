@@ -37,7 +37,8 @@ from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime import serde
 
 _FRAME = struct.Struct("<IBq")          # length, topic, key
-T_WEIGHTS, T_GRADIENTS, T_DATA, T_HELLO, T_READY = 1, 2, 3, 4, 5
+(T_WEIGHTS, T_GRADIENTS, T_DATA, T_HELLO, T_READY,
+ T_PING, T_PONG) = 1, 2, 3, 4, 5, 6, 7
 _TOPIC_NAMES = {T_WEIGHTS: fabric_mod.WEIGHTS_TOPIC,
                 T_GRADIENTS: fabric_mod.GRADIENTS_TOPIC,
                 T_DATA: fabric_mod.INPUT_DATA_TOPIC}
@@ -62,12 +63,33 @@ def recv_frame(sock: socket.socket) -> tuple[int, int, bytes] | None:
     return topic, key, body[9:]
 
 
+def force_close(sock: socket.socket) -> None:
+    """shutdown + close: a plain close() does NOT wake a thread blocked
+    in recv() on the same socket; shutdown(SHUT_RDWR) delivers EOF to
+    it first."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    """Exactly n bytes, or None on a clean EOF before the first byte.
+    EOF after a partial read is a torn frame — a crashed peer, never an
+    orderly shutdown — and raises so the caller treats it as a failure
+    (the reference gets this for free from Kafka's record framing)."""
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
-            return None if not buf else None
+            if buf:
+                raise ConnectionError(
+                    f"mid-frame EOF ({len(buf)}/{n} bytes)")
+            return None
         buf += chunk
     return buf
 
@@ -80,9 +102,23 @@ class ServerBridge:
     Install via `bridge.wrap(fabric)`: the returned fabric routes sends
     addressed to remote workers over their socket and leaves local
     behavior untouched (the Kafka-broker role, minus the broker).
+
+    Failure detection (the consumer-group-rebalance analogue, SURVEY §5):
+    a reader hitting EOF/reset purges the connection's worker ids and
+    fires `on_disconnect(ids)`; a later HELLO re-registers them and
+    fires `on_hello(ids)`; READY fires `on_ready(worker)` — the caller
+    (cli/socket_mode.run_server) turns these into evictions and
+    readmissions on the ServerNode.  With `heartbeat_interval` set the
+    bridge PINGs every connection on that cadence and, when
+    `heartbeat_timeout` is also set, force-closes connections silent for
+    longer than it — half-open TCP (a worker host vanishing without a
+    FIN) then surfaces as a normal disconnect instead of hanging the
+    consistency gate forever.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_interval: float | None = None,
+                 heartbeat_timeout: float | None = None):
         self._listener = socket.create_server((host, port))
         self.port = self._listener.getsockname()[1]
         self._conn_of: dict[int, socket.socket] = {}   # worker -> conn
@@ -92,8 +128,18 @@ class ServerBridge:
         self._fabric: fabric_mod.Fabric | None = None
         self._stop = threading.Event()
         self._send_lock: dict[socket.socket, threading.Lock] = {}
+        self._last_recv: dict[socket.socket, float] = {}
+        self.on_disconnect = None   # Callable[[list[int]], None]
+        self.on_hello = None        # Callable[[list[int]], None]
+        self.on_ready = None        # Callable[[int], None]
+        self.dropped_sends = 0      # frames lost to dead connections
+        self._hb_interval = heartbeat_interval
+        self._hb_timeout = heartbeat_timeout
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="kps-net-accept").start()
+        if heartbeat_interval:
+            threading.Thread(target=self._heartbeat_loop, daemon=True,
+                             name="kps-net-heartbeat").start()
 
     # -- fabric integration ------------------------------------------------
 
@@ -120,13 +166,13 @@ class ServerBridge:
     def send_data(self, worker: int, features: dict[int, float],
                   label: int) -> bool:
         """Forward one stream row to the process hosting `worker`.
-        False if that worker is not (yet) connected."""
+        False if that worker is not (yet) connected or its connection
+        just died — the caller reroutes or counts the row."""
         from kafka_ps_tpu.runtime.messages import LabeledData
         conn = self._conn_of.get(worker)
         if conn is None:
             return False
-        self._send(conn, T_DATA, worker, LabeledData(features, label))
-        return True
+        return self._send(conn, T_DATA, worker, LabeledData(features, label))
 
     def wait_for_connected(self, workers, timeout: float = 60.0) -> None:
         """Block until every worker id has a connection (HELLO seen) —
@@ -165,9 +211,24 @@ class ServerBridge:
 
     # -- internals ---------------------------------------------------------
 
-    def _send(self, conn, topic, key, message) -> None:
-        with self._send_lock[conn]:
-            send_frame(conn, topic, key, serde.to_bytes(message))
+    def _send(self, conn, topic, key, message=None) -> bool:
+        """False (never raises) when the connection is gone: the message
+        is dropped, like a Kafka send to a dead consumer — the reader's
+        disconnect cleanup drives the actual eviction, so a send from
+        inside the consistency gate can't crash the server."""
+        payload = serde.to_bytes(message) if message is not None else b""
+        lock = self._send_lock.get(conn)
+        if lock is None:
+            self.dropped_sends += 1
+            return False
+        try:
+            with lock:
+                send_frame(conn, topic, key, payload)
+            return True
+        except (ConnectionError, OSError):
+            self.dropped_sends += 1
+            force_close(conn)       # wake the reader -> cleanup/eviction
+            return False
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -177,15 +238,30 @@ class ServerBridge:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._send_lock[conn] = threading.Lock()
+            self._last_recv[conn] = time.monotonic()
             threading.Thread(target=self._reader, args=(conn,),
                              daemon=True, name="kps-net-reader").start()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self._hb_interval):
+            now = time.monotonic()
+            for conn in list(self._send_lock):
+                silent = now - self._last_recv.get(conn, now)
+                if (self._hb_timeout is not None
+                        and silent > self._hb_timeout):
+                    # half-open: no FIN will ever come; force the
+                    # reader's recv to fail so cleanup runs
+                    force_close(conn)
+                    continue
+                self._send(conn, T_PING, 0)
 
     def _reader(self, conn: socket.socket) -> None:
         try:
             while not self._stop.is_set():
                 frame = recv_frame(conn)
                 if frame is None:
-                    return
+                    break
+                self._last_recv[conn] = time.monotonic()
                 topic, key, payload = frame
                 if topic == T_HELLO:
                     (n,) = struct.unpack_from("<q", payload, 0)
@@ -194,15 +270,42 @@ class ServerBridge:
                         for w in ids:
                             self._conn_of[w] = conn
                         self._cv.notify_all()
+                    if self.on_hello is not None:
+                        self.on_hello(list(ids))
                 elif topic == T_READY:
                     with self._cv:
                         self._ready.add(key)
                         self._cv.notify_all()
+                    if self.on_ready is not None:
+                        self.on_ready(key)
+                elif topic == T_PONG:
+                    pass            # liveness already stamped above
                 elif topic == T_GRADIENTS and self._fabric is not None:
                     self._fabric.send(fabric_mod.GRADIENTS_TOPIC, 0,
                                       serde.from_bytes(payload))
         except (ConnectionError, OSError):
-            return
+            pass
+        finally:
+            self._cleanup_conn(conn)
+
+    def _cleanup_conn(self, conn: socket.socket) -> None:
+        """Purge a dead connection's registrations and surface the
+        disconnect — without this the consistency gate waits forever for
+        a dead worker's gradients (ADVICE r2 medium)."""
+        try:
+            conn.close()
+        except OSError:
+            pass
+        with self._cv:
+            ids = [w for w, c in self._conn_of.items() if c is conn]
+            for w in ids:
+                del self._conn_of[w]
+                self._ready.discard(w)
+            self._send_lock.pop(conn, None)
+            self._last_recv.pop(conn, None)
+            self._cv.notify_all()
+        if ids and not self._stop.is_set() and self.on_disconnect is not None:
+            self.on_disconnect(ids)
 
 
 class WorkerBridge:
@@ -212,8 +315,14 @@ class WorkerBridge:
     the workers' GRADIENTS sends back over the socket."""
 
     def __init__(self, host: str, port: int, worker_ids: list[int],
-                 connect_timeout: float = 30.0):
+                 connect_timeout: float = 30.0,
+                 heartbeat_timeout: float | None = None):
+        """`heartbeat_timeout`: seconds of total server silence before
+        the connection is declared dead (only sensible when the server
+        PINGs, i.e. it was built with a heartbeat_interval — otherwise a
+        quiet-but-alive server would be misread as gone)."""
         self.worker_ids = list(worker_ids)
+        self._heartbeat_timeout = heartbeat_timeout
         # retry: the server process may still be importing/binding when
         # this process is already up (both launched together, run.sh-style)
         deadline = time.monotonic() + connect_timeout
@@ -226,7 +335,9 @@ class WorkerBridge:
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.2)
-        self._sock.settimeout(None)
+        # a half-open server link surfaces as socket.timeout in the read
+        # loop (TimeoutError is an OSError: same exit path as a reset)
+        self._sock.settimeout(heartbeat_timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._send_lock = threading.Lock()
         self._stop = threading.Event()
@@ -267,6 +378,10 @@ class WorkerBridge:
                 if frame is None:
                     break
                 topic, key, payload = frame
+                if topic == T_PING:
+                    with self._send_lock:
+                        send_frame(self._sock, T_PONG, 0)
+                    continue
                 msg = serde.from_bytes(payload)
                 if topic == T_DATA:
                     buffers[key].add(msg.features, msg.label)

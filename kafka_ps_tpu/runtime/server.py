@@ -72,6 +72,10 @@ class ServerNode:
         self.checkpoint_path: str | None = None
         self.checkpoint_every: int = 50   # <= 0: only save on exit
         self._last_checkpoint_iteration = 0
+        # membership-change record (timestamp_ms, "evict"|"readmit",
+        # worker) — the audit trail the staleness auditor segments
+        # elastic runs by (evaluation/validate.py epoch checking)
+        self.membership_events: list[tuple[int, str, int]] = []
 
     # -- bootstrap (ServerProcessor.java:75-87) ----------------------------
 
@@ -148,6 +152,8 @@ class ServerNode:
         """Evict a failed worker: every consistency gate stops waiting
         for its gradients, and any round it was blocking is released."""
         self.tracker.deactivate_worker(worker)
+        self.membership_events.append(
+            (int(time.time() * 1000), "evict", worker))
         self.tracer.count("server.workers_removed")
         self._flush_gate()
 
@@ -161,6 +167,8 @@ class ServerNode:
                           lambda m: getattr(m, "worker_id", None) == worker)
         self.fabric.purge(fabric_mod.WEIGHTS_TOPIC, worker, lambda m: True)
         clock = self.tracker.reactivate_worker(worker)
+        self.membership_events.append(
+            (int(time.time() * 1000), "readmit", worker))
         self.tracer.count("server.workers_readmitted")
         self.send_weights(worker, clock)
         return clock

@@ -1,0 +1,138 @@
+"""Socket transport framing + failure-detection unit tests
+(runtime/net.py): torn frames must surface as ConnectionError, never be
+mistaken for an orderly shutdown (ADVICE r2: _recv_exact returned None
+on both clean and mid-header EOF), and the ServerBridge must purge and
+report dead connections instead of leaving the consistency gate waiting
+forever.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from kafka_ps_tpu.runtime import net, serde
+from kafka_ps_tpu.runtime.messages import WeightsMessage, KeyRange
+
+import numpy as np
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def test_clean_eof_returns_none():
+    a, b = _pair()
+    a.close()
+    assert net.recv_frame(b) is None
+    b.close()
+
+
+def test_mid_header_eof_raises():
+    a, b = _pair()
+    a.sendall(b"\x02\x00")          # 2 of the 4 length bytes
+    a.close()
+    with pytest.raises(ConnectionError, match="mid-frame"):
+        net.recv_frame(b)
+    b.close()
+
+
+def test_mid_body_eof_raises():
+    a, b = _pair()
+    # header claims a 32-byte body; deliver only 5
+    a.sendall(struct.pack("<I", 32) + b"\x01\x00\x00\x00\x00")
+    a.close()
+    with pytest.raises(ConnectionError, match="mid-frame"):
+        net.recv_frame(b)
+    b.close()
+
+
+def test_whole_frame_roundtrip():
+    a, b = _pair()
+    msg = WeightsMessage(vector_clock=3, key_range=KeyRange(0, 4),
+                         values=np.arange(4, dtype=np.float32))
+    net.send_frame(a, net.T_WEIGHTS, 2, serde.to_bytes(msg))
+    topic, key, payload = net.recv_frame(b)
+    assert (topic, key) == (net.T_WEIGHTS, 2)
+    got = serde.from_bytes(payload)
+    assert got.vector_clock == 3 and got.key_range == KeyRange(0, 4)
+    np.testing.assert_array_equal(got.values, msg.values)
+    a.close(), b.close()
+
+
+def _connect_worker(port: int, ids: list[int],
+                    heartbeat_timeout: float | None = None):
+    return net.WorkerBridge("127.0.0.1", port, ids,
+                            heartbeat_timeout=heartbeat_timeout)
+
+
+def test_server_bridge_reports_disconnect_and_purges():
+    bridge = net.ServerBridge()
+    gone: list[list[int]] = []
+    bridge.on_disconnect = lambda ids: gone.append(sorted(ids))
+    worker = _connect_worker(bridge.port, [0, 1])
+    bridge.wait_for_connected([0, 1], timeout=10.0)
+    worker._sock.close()            # hard death — no goodbye frame
+    deadline = time.monotonic() + 10.0
+    while not gone and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert gone == [[0, 1]]
+    assert bridge._conn_of == {}
+    assert not bridge.send_data(0, {0: 1.0}, 1)   # no crash, just False
+    bridge.close()
+
+
+def test_server_bridge_reconnect_reregisters():
+    bridge = net.ServerBridge()
+    events: list[tuple[str, object]] = []
+    bridge.on_disconnect = lambda ids: events.append(("down", sorted(ids)))
+    bridge.on_hello = lambda ids: events.append(("hello", sorted(ids)))
+    w1 = _connect_worker(bridge.port, [0])
+    bridge.wait_for_connected([0], timeout=10.0)
+    w1._sock.close()
+    deadline = time.monotonic() + 10.0
+    while ("down", [0]) not in events and time.monotonic() < deadline:
+        time.sleep(0.01)
+    w2 = _connect_worker(bridge.port, [0])
+    bridge.wait_for_connected([0], timeout=10.0)   # re-registered
+    assert ("hello", [0]) in events
+    w2.close(), bridge.close()
+
+
+def test_heartbeat_detects_half_open_connection():
+    """A peer that stops reading/writing without closing (SIGSTOP'd
+    process, vanished host) must be evicted by the PING/timeout path."""
+    bridge = net.ServerBridge(heartbeat_interval=0.05,
+                              heartbeat_timeout=0.4)
+    gone: list[list[int]] = []
+    bridge.on_disconnect = lambda ids: gone.append(sorted(ids))
+    # raw socket that HELLOs then goes silent (never PONGs)
+    sock = socket.create_connection(("127.0.0.1", bridge.port))
+    payload = struct.pack("<qq", 1, 7)
+    net.send_frame(sock, net.T_HELLO, 0, payload)
+    deadline = time.monotonic() + 10.0
+    while not gone and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert gone == [[7]]
+    sock.close(), bridge.close()
+
+
+def test_worker_bridge_pongs_keep_connection_alive():
+    """A PONGing worker must NOT be evicted by the heartbeat."""
+    bridge = net.ServerBridge(heartbeat_interval=0.05,
+                              heartbeat_timeout=0.5)
+    gone: list[list[int]] = []
+    bridge.on_disconnect = lambda ids: gone.append(sorted(ids))
+    worker = _connect_worker(bridge.port, [3], heartbeat_timeout=2.0)
+    bridge.wait_for_connected([3], timeout=10.0)
+    t = threading.Thread(target=worker.run_reader, args=({},), daemon=True)
+    t.start()                       # reader answers PINGs
+    time.sleep(1.5)                 # >> heartbeat_timeout
+    assert gone == []
+    assert 3 in bridge._conn_of
+    worker.close(), bridge.close()
