@@ -317,7 +317,8 @@ def run_with_args(args) -> int:
                 and app.server.membership_events):
             from kafka_ps_tpu.cli.socket_mode import write_events_log
             write_events_log("./logs-events.csv",
-                             app.server.membership_events)
+                             app.server.membership_events,
+                             append=resuming)
         for log in logs:
             log.close()
         if args.trace:

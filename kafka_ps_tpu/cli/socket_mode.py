@@ -20,6 +20,7 @@ delay and eventual consistency have a real multi-host story too.
 
 from __future__ import annotations
 
+import os
 import queue
 import sys
 import threading
@@ -33,14 +34,17 @@ from kafka_ps_tpu.runtime import net
 EVENTS_HEADER = "timestamp;event;partition"
 
 
-def write_events_log(path: str, events) -> None:
+def write_events_log(path: str, events, append: bool = False) -> None:
     """Persist the server's membership-change record (the eviction /
     readmission audit trail the staleness auditor segments elastic runs
-    by, evaluation/validate.py)."""
-    with open(path, "w") as fh:
-        fh.write(EVENTS_HEADER + "\n")
-        for ts, kind, worker in events:
-            fh.write(f"{ts};{kind};{worker}\n")
+    by, evaluation/validate.py).  `append=True` (checkpoint-resumed
+    runs) continues the prior run's record — the auditor needs the FULL
+    event history to segment a log that spans the resume."""
+    from kafka_ps_tpu.utils.csvlog import CsvLogSink
+    sink = CsvLogSink(path, EVENTS_HEADER, append=append)
+    for ts, kind, worker in events:
+        sink(f"{ts};{kind};{worker}")
+    sink.close()
 
 
 def _make_cfg(args):
@@ -94,8 +98,13 @@ def run_server(args) -> int:
     hb_timeout = getattr(args, "heartbeat_timeout", None)
     test_x, test_y = load_test_csv(args.test_data_file_path,
                                    args.num_features)
+    # a resumed run must CONTINUE the prior run's log, not truncate it
+    # (mirrors cli/run.py's make_app_from_args; post-run validation
+    # audits the log across the resume)
+    checkpoint_path = getattr(args, "checkpoint", None)
+    resuming = bool(checkpoint_path) and os.path.exists(checkpoint_path)
     log = CsvLogSink("./logs-server.csv" if args.logging else None,
-                     SERVER_HEADER)
+                     SERVER_HEADER, append=resuming)
     bridge = net.ServerBridge(
         port=args.listen,
         heartbeat_interval=min(1.0, hb_timeout / 3) if hb_timeout else 1.0,
@@ -104,11 +113,9 @@ def run_server(args) -> int:
     fabric = bridge.wrap(fabric_mod.Fabric())
     server = ServerNode(cfg, fabric, test_x, test_y, log)
 
-    checkpoint_path = getattr(args, "checkpoint", None)
-    resuming = False
     if checkpoint_path:
         from kafka_ps_tpu.utils import checkpoint as ckpt
-        resuming = ckpt.maybe_restore(checkpoint_path, server)
+        ckpt.maybe_restore(checkpoint_path, server)
         server.checkpoint_path = checkpoint_path
         server.checkpoint_every = getattr(args, "checkpoint_every", 50)
         if resuming:
@@ -129,12 +136,17 @@ def run_server(args) -> int:
 
     def sink(worker: int, features: dict[int, float], label: int) -> None:
         # Rows flow to whoever holds the worker's connection — including
-        # a reconnected-but-not-yet-readmitted process (its buffer must
-        # fill before READY triggers readmission).  A dead target
-        # reroutes round-robin to the survivors (the partition
-        # reassignment of a consumer-group rebalance); with nobody left
-        # the row is counted, not silently discarded.
-        if bridge.send_data(worker, features, label):
+        # (under rebalance) a reconnected-but-not-yet-readmitted process,
+        # whose buffer must fill before READY triggers readmission.
+        # Under halt an inactive worker can never be readmitted, so a
+        # reconnected-evicted target (checkpoint carrying evictions)
+        # would swallow its partition's rows forever — reroute instead.
+        # A dead target reroutes round-robin to the survivors (the
+        # partition reassignment of a consumer-group rebalance); with
+        # nobody left the row is counted, not silently discarded.
+        deliverable = (failure_policy == "rebalance"
+                       or server.tracker.tracker[worker].active)
+        if deliverable and bridge.send_data(worker, features, label):
             return
         active = server.tracker.active_workers
         for _ in range(len(active)):
@@ -201,7 +213,8 @@ def run_server(args) -> int:
             print(f"dropped rows: {reroute['dropped']}, dropped sends: "
                   f"{bridge.dropped_sends}", file=sys.stderr, flush=True)
         if args.logging and server.membership_events:
-            write_events_log("./logs-events.csv", server.membership_events)
+            write_events_log("./logs-events.csv", server.membership_events,
+                             append=resuming)
         log.close()
     return 0
 

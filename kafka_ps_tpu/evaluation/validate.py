@@ -29,6 +29,7 @@ k = 0 / 10 / eventual — at the bound for both checked models.  Usage:
 from __future__ import annotations
 
 import dataclasses
+import warnings
 
 import pandas as pd
 
@@ -104,6 +105,13 @@ def validate_worker_log(worker_df: pd.DataFrame,
     return out
 
 
+# Membership-event timestamps come from the server's host clock; log
+# rows from worker host clocks.  Ordering across that boundary is only
+# trustworthy up to NTP-grade skew — interleavings wider than this are
+# reported as suspicious rather than silently re-segmented.
+CLOCK_SKEW_WARN_MS = 10_000
+
+
 def _validate_elastic_epochs(worker_df: pd.DataFrame,
                              consistency_model: int,
                              membership_events: list[MembershipEvent]
@@ -112,14 +120,29 @@ def _validate_elastic_epochs(worker_df: pd.DataFrame,
     each epoch (the interval between two membership changes) against
     the same contract a static run gets.  Events order before log rows
     on timestamp ties: the server records the change before the
-    affected traffic flows."""
+    affected traffic flows.
+
+    Cross-host clock skew (ADVICE r3): in split mode the events carry
+    the SERVER host's clock and the rows each WORKER host's clock, so
+    the merged order is only approximate.  Readmissions are therefore
+    applied by PROTOCOL STATE, not wall clock: the rejoin row is the
+    first row of an inactive worker that either follows its readmit
+    event on the timeline or breaks its frozen +1 chain while an
+    unconsumed readmit event for it exists nearby (within
+    CLOCK_SKEW_WARN_MS) — a row skew-sorted before its own readmission
+    is then still classified as the rejoin, counted into the spread,
+    and the skew reported via `warnings`.  Evictions still segment on
+    the merged timeline (a pre-evict row is indistinguishable from a
+    legal last-gasp +1 continuation by content alone); last-gasp rows
+    arriving implausibly long after the eviction are warned about."""
     out: list[Violation] = []
     bound = consistency_model + 1
     check_bound = consistency_model != EVENTUAL
 
     rows = worker_df.sort_values("timestamp", kind="stable")
+    events_sorted = sorted(membership_events, key=lambda e: e[0])
     timeline: list[tuple[int, int, object]] = []   # (ts, order, item)
-    for ev in sorted(membership_events, key=lambda e: e[0]):
+    for ev in events_sorted:
         timeline.append((int(ev[0]), 0, ev))
     for _, row in rows.iterrows():
         timeline.append((int(row["timestamp"]), 1,
@@ -129,36 +152,22 @@ def _validate_elastic_epochs(worker_df: pd.DataFrame,
     active = {int(w) for w in worker_df["partition"].unique()}
     active |= {int(w) for _, _, w in membership_events}
     latest: dict[int, int] = {}         # last logged clock per worker
-    # workers whose NEXT log row follows their own readmission: the +1
-    # step check is suspended for exactly that one row
-    rejoined: set[int] = set()
+    frozen: dict[int, int] = {}         # evicted workers' +1 chains
+    evicted_at: dict[int, int] = {}     # worker -> evict event ts
+    # per worker: timestamps of readmit events not yet consumed — either
+    # reached on the timeline (-> pending) or claimed EARLY by a row
+    # whose host clock sorts it before its own readmit event
+    readmit_times: dict[int, list[int]] = {}
+    evict_times: dict[int, list[int]] = {}
+    for ts_, kind_, w_ in events_sorted:
+        if kind_ == "readmit":
+            readmit_times.setdefault(int(w_), []).append(int(ts_))
+        else:
+            evict_times.setdefault(int(w_), []).append(int(ts_))
+    pending_readmit: dict[int, int] = {}
+    early_claims: dict[int, int] = {}
 
-    for ts, kind_order, item in timeline:
-        if kind_order == 0:             # membership event
-            _, kind, w = item
-            w = int(w)
-            if kind == "evict":
-                active.discard(w)
-                latest.pop(w, None)     # frozen clock leaves the spread
-            else:                       # readmit
-                active.add(w)
-                rejoined.add(w)
-            continue
-        w, clock = item
-        prev = latest.get(w)
-        if w in rejoined:
-            rejoined.discard(w)
-        elif prev is not None and clock != prev + 1:
-            out.append(Violation(
-                "clock-step",
-                f"worker {w}: clock {prev} -> {clock} "
-                f"(expected {prev + 1}) at timestamp {ts}"))
-        if w not in active:
-            # last-gasp row from an evicted worker (in flight at the
-            # eviction): legal, but its frozen clock must not rejoin
-            # the spread
-            continue
-        latest[w] = clock
+    def spread_check(ts: int) -> None:
         if check_bound and len(latest) > 1:
             spread = max(latest.values()) - min(latest.values())
             if spread > bound:
@@ -166,6 +175,88 @@ def _validate_elastic_epochs(worker_df: pd.DataFrame,
                     "staleness-bound",
                     f"spread {spread} > bound {bound} at timestamp "
                     f"{ts} (clocks {dict(sorted(latest.items()))})"))
+
+    for ts, kind_order, item in timeline:
+        if kind_order == 0:             # membership event
+            _, kind, w = item
+            w = int(w)
+            if kind == "evict":
+                active.discard(w)
+                if w in latest:         # frozen clock leaves the spread
+                    frozen[w] = latest.pop(w)
+                evicted_at[w] = ts
+                # a readmission the worker never logged under is voided
+                # by its re-eviction — without this, its next in-flight
+                # row would be misread as a rejoin and its frozen clock
+                # would re-enter the spread permanently
+                for _ in range(pending_readmit.get(w, 0)):
+                    readmit_times[w].pop(0)
+                pending_readmit[w] = 0
+            elif early_claims.get(w, 0) > 0:
+                early_claims[w] -= 1    # a skew-sorted row already took it
+            else:
+                pending_readmit[w] = pending_readmit.get(w, 0) + 1
+            continue
+        w, clock = item
+        if w not in active:
+            prev = frozen.get(w)
+            rejoin = False
+            if pending_readmit.get(w, 0) > 0:
+                pending_readmit[w] -= 1
+                readmit_times[w].pop(0)
+                rejoin = True
+            elif (readmit_times.get(w)
+                    # a truly broken +1 chain — `prev is None` is NOT a
+                    # break: a worker evicted before its first row sends
+                    # a perfectly legal in-flight first row, which must
+                    # stay a last-gasp (the pending path classifies its
+                    # real rejoin correctly)
+                    and prev is not None and clock != prev + 1
+                    and readmit_times[w][0] - ts <= CLOCK_SKEW_WARN_MS
+                    # a claim must not reach ACROSS an evict for this
+                    # worker: in a corrupted event log (e.g. double
+                    # evict) that would swallow the readmit and push the
+                    # worker's real rejoin rows out of the spread forever
+                    and not any(ts < e <= readmit_times[w][0]
+                                for e in evict_times.get(w, ()))):
+                # protocol state says rejoin even though this row's host
+                # clock sorts it before its own readmit event
+                readmit_times[w].pop(0)
+                early_claims[w] = early_claims.get(w, 0) + 1
+                rejoin = True
+                warnings.warn(
+                    f"worker {w}: rejoin row at {ts} precedes its "
+                    "readmit event — cross-host clock skew; ordered by "
+                    "protocol state instead")
+            if rejoin:
+                active.add(w)
+                frozen.pop(w, None)
+                latest[w] = clock       # no +1 check on the rejoin row
+                spread_check(ts)
+            else:
+                # last-gasp row in flight at the eviction: continues the
+                # frozen chain but stays out of the spread
+                if prev is not None and clock != prev + 1:
+                    out.append(Violation(
+                        "clock-step",
+                        f"evicted worker {w}: clock {prev} -> {clock} "
+                        f"(expected {prev + 1}) at timestamp {ts}"))
+                frozen[w] = clock
+                if ts - evicted_at.get(w, ts) > CLOCK_SKEW_WARN_MS:
+                    warnings.warn(
+                        f"worker {w}: row at {ts} arrived "
+                        f"{ts - evicted_at[w]}ms after its eviction — "
+                        "possible clock skew mis-segmenting this epoch "
+                        "(epoch validation assumes NTP-synced hosts)")
+            continue
+        prev = latest.get(w)
+        if prev is not None and clock != prev + 1:
+            out.append(Violation(
+                "clock-step",
+                f"worker {w}: clock {prev} -> {clock} "
+                f"(expected {prev + 1}) at timestamp {ts}"))
+        latest[w] = clock
+        spread_check(ts)
     return out
 
 

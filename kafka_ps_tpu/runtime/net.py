@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import socket
 import struct
+import sys
 import threading
 import time
 
@@ -38,7 +39,8 @@ from kafka_ps_tpu.runtime import serde
 
 _FRAME = struct.Struct("<IBq")          # length, topic, key
 (T_WEIGHTS, T_GRADIENTS, T_DATA, T_HELLO, T_READY,
- T_PING, T_PONG) = 1, 2, 3, 4, 5, 6, 7
+ T_PING, T_PONG, T_CONFIG) = 1, 2, 3, 4, 5, 6, 7, 8
+_CONFIG_GRACE = 10.0    # read timeout until T_CONFIG arrives (s)
 _TOPIC_NAMES = {T_WEIGHTS: fabric_mod.WEIGHTS_TOPIC,
                 T_GRADIENTS: fabric_mod.GRADIENTS_TOPIC,
                 T_DATA: fabric_mod.INPUT_DATA_TOPIC}
@@ -217,16 +219,22 @@ class ServerBridge:
         disconnect cleanup drives the actual eviction, so a send from
         inside the consistency gate can't crash the server."""
         payload = serde.to_bytes(message) if message is not None else b""
+        return self._send_raw(conn, topic, key, payload)
+
+    def _send_raw(self, conn, topic, key, payload: bytes) -> bool:
+        # `dropped_sends` is a data-loss diagnostic: a control frame
+        # (PING/CONFIG) hitting a dying connection is not lost data
+        count = topic not in (T_PING, T_CONFIG)
         lock = self._send_lock.get(conn)
         if lock is None:
-            self.dropped_sends += 1
+            self.dropped_sends += count
             return False
         try:
             with lock:
                 send_frame(conn, topic, key, payload)
             return True
         except (ConnectionError, OSError):
-            self.dropped_sends += 1
+            self.dropped_sends += count
             force_close(conn)       # wake the reader -> cleanup/eviction
             return False
 
@@ -270,6 +278,11 @@ class ServerBridge:
                         for w in ids:
                             self._conn_of[w] = conn
                         self._cv.notify_all()
+                    # advertise the PING cadence so the worker can floor
+                    # its read timeout instead of guessing (0.0 = no
+                    # heartbeats; the worker must not time out at all)
+                    self._send_raw(conn, T_CONFIG, 0,
+                                   struct.pack("<d", self._hb_interval or 0.0))
                     if self.on_hello is not None:
                         self.on_hello(list(ids))
                 elif topic == T_READY:
@@ -336,8 +349,18 @@ class WorkerBridge:
                     raise
                 time.sleep(0.2)
         # a half-open server link surfaces as socket.timeout in the read
-        # loop (TimeoutError is an OSError: same exit path as a reset)
-        self._sock.settimeout(heartbeat_timeout)
+        # loop (TimeoutError is an OSError: same exit path as a reset).
+        # Until the server advertises its ping cadence (T_CONFIG) the
+        # flag value cannot be trusted — a sub-ping timeout applied now
+        # would false-declare the server dead before the first ping —
+        # so the pre-config window gets a generous grace instead
+        if heartbeat_timeout is not None:
+            self._sock.settimeout(max(heartbeat_timeout, _CONFIG_GRACE))
+        else:
+            # clear the 5 s connect timeout create_connection left on
+            # the socket: with no heartbeat flag the worker must block
+            # on a quiet-but-alive server indefinitely
+            self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._send_lock = threading.Lock()
         self._stop = threading.Event()
@@ -364,6 +387,31 @@ class WorkerBridge:
         self.fabric = BridgedFabric()
         return self.fabric
 
+    def _apply_server_ping_interval(self, interval: float) -> None:
+        """React to the server's advertised PING cadence (T_CONFIG, sent
+        right after HELLO).  The worker's `heartbeat_timeout` and the
+        server's ping interval are independent flags in different
+        processes; a timeout below a few pings false-declares a healthy
+        server dead and kills the whole worker process (ADVICE r3) — so
+        the effective read timeout is floored at 3 pings, and disabled
+        entirely when the server does not ping at all."""
+        if self._heartbeat_timeout is None:
+            return
+        if interval <= 0.0:
+            print(f"warning: server sends no heartbeats; ignoring "
+                  f"heartbeat_timeout={self._heartbeat_timeout}s",
+                  file=sys.stderr, flush=True)
+            self._sock.settimeout(None)
+            return
+        floor = 3.0 * interval
+        effective = self._heartbeat_timeout
+        if effective < floor:
+            print(f"warning: heartbeat_timeout={effective}s is under 3x "
+                  f"the server ping interval ({interval}s); using "
+                  f"{floor}s", file=sys.stderr, flush=True)
+            effective = floor
+        self._sock.settimeout(effective)
+
     def mark_ready(self, worker: int) -> None:
         with self._send_lock:
             send_frame(self._sock, T_READY, worker)
@@ -381,6 +429,10 @@ class WorkerBridge:
                 if topic == T_PING:
                     with self._send_lock:
                         send_frame(self._sock, T_PONG, 0)
+                    continue
+                if topic == T_CONFIG:
+                    (interval,) = struct.unpack_from("<d", payload, 0)
+                    self._apply_server_ping_interval(interval)
                     continue
                 msg = serde.from_bytes(payload)
                 if topic == T_DATA:

@@ -136,3 +136,59 @@ def test_worker_bridge_pongs_keep_connection_alive():
     assert gone == []
     assert 3 in bridge._conn_of
     worker.close(), bridge.close()
+
+
+def test_default_worker_has_no_read_timeout():
+    """With no --heartbeat_timeout the worker must block on a quiet
+    server forever — create_connection's 5 s connect timeout must not
+    survive onto the established socket."""
+    bridge = net.ServerBridge()
+    worker = _connect_worker(bridge.port, [1])
+    assert worker._sock.gettimeout() is None
+    worker.close(), bridge.close()
+
+
+def test_ping_failures_not_counted_as_dropped_sends(capsys):
+    """ADVICE r3: `dropped_sends` diagnoses lost DATA/WEIGHTS frames; a
+    PING hitting a dead connection must not inflate it."""
+    bridge = net.ServerBridge()
+    dead = object()                     # never registered -> no lock
+    assert bridge._send_raw(dead, net.T_PING, 0, b"") is False
+    assert bridge.dropped_sends == 0
+    assert bridge._send_raw(dead, net.T_WEIGHTS, 0, b"") is False
+    assert bridge.dropped_sends == 1
+    bridge.close()
+
+
+def test_config_frame_floors_too_small_heartbeat_timeout(capsys):
+    """ADVICE r3: a worker's heartbeat_timeout below the server's ping
+    cadence would false-declare a healthy server dead; the advertised
+    interval (T_CONFIG, sent on HELLO) floors it at 3 pings."""
+    bridge = net.ServerBridge(heartbeat_interval=0.5,
+                              heartbeat_timeout=30.0)
+    worker = _connect_worker(bridge.port, [1], heartbeat_timeout=0.1)
+    t = threading.Thread(target=worker.run_reader, args=({},), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10.0
+    while worker._sock.gettimeout() != 1.5 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert worker._sock.gettimeout() == pytest.approx(1.5)
+    assert not worker.disconnected.is_set()
+    worker.close(), bridge.close()
+
+
+def test_config_frame_disables_timeout_when_server_never_pings():
+    """A quiet-but-alive server (no heartbeat_interval) must not be
+    misread as dead no matter the worker's timeout flag."""
+    bridge = net.ServerBridge()         # no heartbeats
+    worker = _connect_worker(bridge.port, [1], heartbeat_timeout=0.2)
+    t = threading.Thread(target=worker.run_reader, args=({},), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10.0
+    while worker._sock.gettimeout() is not None \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert worker._sock.gettimeout() is None
+    time.sleep(0.5)                     # >> the 0.2 s flag
+    assert not worker.disconnected.is_set()
+    worker.close(), bridge.close()

@@ -100,3 +100,136 @@ def test_elastic_mode_allows_equal_clock_on_rejoin():
     advanced — the worker legitimately re-logs the same clock."""
     rows = [(0, 0, 0), (1, 0, 1), (2, 0, 2), (50, 0, 2), (51, 0, 3)]
     assert validate.validate_worker_log(_wdf(rows), 0, elastic=True) == []
+
+
+# -- epoch-segmented elastic validation (membership events) ------------------
+
+def _elastic(rows, events, k=0):
+    return validate.validate_worker_log(
+        _wdf(rows), k, elastic=True, membership_events=events)
+
+
+def test_epochs_clean_evict_and_readmit():
+    """Evict frees the gate (survivor runs ahead), readmit rejoins at a
+    jumped clock — both epochs individually honor the k+1 bound."""
+    rows = [(0, 0, 0), (1, 1, 0), (2, 0, 1), (3, 1, 1),
+            # worker 1 dies; survivor 0 runs ahead alone (sequential)
+            (20, 0, 2), (21, 0, 3), (22, 0, 4),
+            # worker 1 rejoins at the survivor's clock
+            (40, 1, 4), (41, 0, 5), (42, 1, 5)]
+    events = [(10, "evict", 1), (35, "readmit", 1)]
+    assert _elastic(rows, events, k=0) == []
+
+
+def test_epochs_frozen_clock_leaves_spread():
+    """Without the eviction event the dead worker's frozen clock would
+    blow the k+1 bound; the epoch validator must drop it."""
+    rows = [(0, 0, 0), (1, 1, 0), (2, 0, 1), (3, 1, 1),
+            (20, 0, 2), (21, 0, 3), (22, 0, 4), (23, 0, 5)]
+    events = [(10, "evict", 1)]
+    assert _elastic(rows, events, k=0) == []
+    # sanity: the static elastic check can't catch this (no bound), and
+    # treating worker 1 as live would violate (spread 4 > 1)
+    v = validate.validate_worker_log(_wdf(rows), 0)
+    assert any(x.rule == "staleness-bound" for x in v)
+
+
+def test_epochs_detect_violation_within_epoch():
+    """A genuine bound violation BETWEEN membership changes is caught."""
+    rows = [(0, 0, 0), (1, 1, 0),
+            (2, 0, 1), (3, 0, 2), (4, 0, 3)]   # spread 3 > 1, both live
+    events = [(50, "evict", 1)]
+    v = _elastic(rows, events, k=0)
+    assert any(x.rule == "staleness-bound" for x in v)
+
+
+def test_epochs_clock_step_still_checked():
+    rows = [(0, 0, 0), (1, 0, 2)]              # skip with no membership
+    v = _elastic(rows, [(50, "evict", 1)], k=EVENTUAL)
+    assert len(v) == 1 and v[0].rule == "clock-step"
+
+
+def test_epochs_last_gasp_row_tolerated():
+    """A row in flight at the eviction (continuing the +1 chain) is
+    legal and stays out of the spread."""
+    rows = [(0, 0, 0), (1, 1, 0), (2, 0, 1), (3, 1, 1),
+            (12, 1, 2),                        # in-flight at the evict
+            (20, 0, 2), (21, 0, 3), (22, 0, 4)]
+    events = [(10, "evict", 1)]
+    assert _elastic(rows, events, k=0) == []
+
+
+def test_epochs_skewed_rejoin_row_ordered_by_protocol_state():
+    """ADVICE r3 medium: a rejoin row whose worker-host clock sorts it
+    BEFORE its own readmit event (cross-host skew) must still be
+    classified as the rejoin — counted into the spread, no false
+    clock-step — with a warning about the skew."""
+    rows = [(0, 0, 0), (1, 1, 0), (2, 0, 1), (3, 1, 1),
+            (20, 0, 2), (21, 0, 3), (22, 0, 4),
+            (33, 1, 4),    # rejoin row: ts 33 < readmit event ts 35
+            (41, 0, 5), (42, 1, 5)]
+    events = [(10, "evict", 1), (35, "readmit", 1)]
+    with pytest.warns(UserWarning, match="clock skew"):
+        assert _elastic(rows, events, k=0) == []
+
+
+def test_epochs_skewed_rejoin_still_catches_violations_after():
+    """The skew-claimed rejoin re-enters the spread: a later divergence
+    inside the new epoch is still caught."""
+    rows = [(0, 0, 0), (1, 1, 0), (2, 0, 1), (3, 1, 1),
+            (20, 0, 2), (21, 0, 3),
+            (33, 1, 3),                        # skewed rejoin at clock 3
+            (41, 0, 4), (43, 0, 5), (44, 0, 6)]  # 0 runs away: spread 3
+    events = [(10, "evict", 1), (35, "readmit", 1)]
+    with pytest.warns(UserWarning):
+        v = _elastic(rows, events, k=0)
+    assert any(x.rule == "staleness-bound" for x in v)
+
+
+def test_epochs_reevict_voids_unconsumed_readmit():
+    """A worker readmitted then re-evicted BEFORE logging any row: its
+    in-flight row afterwards is a last-gasp, not a rejoin — it must not
+    re-enter the spread (else the survivor's progress reads as false
+    staleness violations)."""
+    rows = [(0, 0, 0), (1, 1, 0), (2, 0, 1), (3, 1, 1),
+            (60, 1, 2),                        # in-flight at 2nd evict
+            (70, 0, 2), (71, 0, 3), (72, 0, 4), (73, 0, 5)]
+    events = [(10, "evict", 1), (35, "readmit", 1), (50, "evict", 1)]
+    assert _elastic(rows, events, k=0) == []
+
+
+def test_epochs_early_claim_cannot_cross_an_evict():
+    """Even against a corrupted event log (double evict), a
+    chain-breaking row must not early-claim a readmit that lies beyond
+    an intervening evict — the worker's REAL rejoin row would otherwise
+    be misread as a last-gasp and leave the spread unguarded."""
+    rows = [(0, 0, 0), (1, 1, 0), (2, 0, 1), (3, 1, 1),
+            (39, 1, 3),    # anomalous chain break while evicted
+            (50, 1, 6),    # the genuine rejoin row (readmit at 41)
+            (52, 0, 2)]    # worker 0 lags: spread 4 must be caught
+    events = [(10, "evict", 1), (40, "evict", 1), (41, "readmit", 1)]
+    v = _elastic(rows, events, k=0)
+    assert any(x.rule == "staleness-bound" for x in v)
+
+
+def test_epochs_first_row_of_preevicted_worker_is_not_a_rejoin():
+    """A worker evicted before logging anything sends a legal in-flight
+    first row; it must stay a last-gasp (out of the spread) — the real
+    rejoin row is the one after the readmit event."""
+    rows = [(0, 0, 0), (1, 0, 1), (2, 0, 2), (3, 0, 3), (4, 0, 4),
+            (30, 1, 0),    # in-flight first row of the evicted worker
+            (37, 1, 5),    # genuine rejoin (readmit at 35)
+            (40, 0, 5)]
+    events = [(10, "evict", 1), (35, "readmit", 1)]
+    assert _elastic(rows, events, k=0) == []
+
+
+def test_epochs_late_last_gasp_warns():
+    """A +1-chain row arriving implausibly long after the eviction is
+    tolerated but flagged as possible clock skew."""
+    rows = [(0, 0, 0), (1, 1, 0), (2, 0, 1), (3, 1, 1),
+            (20, 0, 2), (21, 0, 3),
+            (10 + validate.CLOCK_SKEW_WARN_MS + 1, 1, 2)]
+    events = [(10, "evict", 1)]
+    with pytest.warns(UserWarning, match="after its eviction"):
+        assert _elastic(rows, events, k=0) == []
