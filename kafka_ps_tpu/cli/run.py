@@ -240,15 +240,34 @@ def run_with_args(args) -> int:
     app, logs = make_app_from_args(args, resuming=resuming,
                                    process_index=process_index)
 
+    # membership/resume events persist incrementally (one writer per
+    # job): an end-of-run dump would lose the auditor's record on a
+    # crash — the exact case the events segment elastic logs for
+    from kafka_ps_tpu.utils.csvlog import (CsvLogSink as _Sink,
+                                           NullLogSink as _Null,
+                                           EVENTS_HEADER)
+    events_log = (_Sink("./logs-events.csv", EVENTS_HEADER,
+                        append=resuming)
+                  if (args.logging and process_index == 0) else _Null())
+    app.server.membership_log = events_log
+    logs = [*logs, events_log]
+
     if args.checkpoint:
         from kafka_ps_tpu.utils import checkpoint as ckpt
-        restored = ckpt.maybe_restore(args.checkpoint, app.server)
+        # single-process runs fold every worker's buffer into the
+        # checkpoint (the durable training window); in a multi-host job
+        # buffers are fed process-locally, so the coordinator's copies
+        # of remote workers' buffers would be empty lies — skip them
+        ckpt_buffers = app.buffers if not distributed else None
+        restored = ckpt.maybe_restore(args.checkpoint, app.server,
+                                      buffers=ckpt_buffers)
         if restored and args.verbose:
             print(f"    restored checkpoint at iteration "
                   f"{app.server.iterations}")
         if process_index == 0:   # one checkpoint writer per job
             app.server.checkpoint_path = args.checkpoint
             app.server.checkpoint_every = args.checkpoint_every
+            app.server.checkpoint_buffers = ckpt_buffers
 
     # mesh + data-partition assignment come AFTER checkpoint restore: a
     # restored checkpoint can carry evictions, and both the divisibility
@@ -312,13 +331,8 @@ def run_with_args(args) -> int:
     finally:
         if args.checkpoint and process_index == 0:
             from kafka_ps_tpu.utils import checkpoint as ckpt
-            ckpt.save(args.checkpoint, app.server)
-        if (args.logging and process_index == 0
-                and app.server.membership_events):
-            from kafka_ps_tpu.cli.socket_mode import write_events_log
-            write_events_log("./logs-events.csv",
-                             app.server.membership_events,
-                             append=resuming)
+            ckpt.save(args.checkpoint, app.server,
+                      buffers=app.server.checkpoint_buffers)
         for log in logs:
             log.close()
         if args.trace:

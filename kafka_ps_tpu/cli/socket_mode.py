@@ -31,22 +31,6 @@ import numpy as np
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime import net
 
-EVENTS_HEADER = "timestamp;event;partition"
-
-
-def write_events_log(path: str, events, append: bool = False) -> None:
-    """Persist the server's membership-change record (the eviction /
-    readmission audit trail the staleness auditor segments elastic runs
-    by, evaluation/validate.py).  `append=True` (checkpoint-resumed
-    runs) continues the prior run's record — the auditor needs the FULL
-    event history to segment a log that spans the resume."""
-    from kafka_ps_tpu.utils.csvlog import CsvLogSink
-    sink = CsvLogSink(path, EVENTS_HEADER, append=append)
-    for ts, kind, worker in events:
-        sink(f"{ts};{kind};{worker}")
-    sink.close()
-
-
 def _make_cfg(args):
     from kafka_ps_tpu.cli.run import apply_platform_env
     from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
@@ -91,27 +75,45 @@ def run_server(args) -> int:
     from kafka_ps_tpu.cli.run import load_test_csv
     from kafka_ps_tpu.data.stream import CsvStreamProducer
     from kafka_ps_tpu.runtime.server import ServerNode
-    from kafka_ps_tpu.utils.csvlog import CsvLogSink, SERVER_HEADER
+    from kafka_ps_tpu.utils.csvlog import (CsvLogSink, EVENTS_HEADER,
+                                           NullLogSink, SERVER_HEADER)
 
     cfg = _make_cfg(args)
     failure_policy = getattr(args, "failure_policy", "halt")
     hb_timeout = getattr(args, "heartbeat_timeout", None)
     test_x, test_y = load_test_csv(args.test_data_file_path,
                                    args.num_features)
-    # a resumed run must CONTINUE the prior run's log, not truncate it
-    # (mirrors cli/run.py's make_app_from_args; post-run validation
-    # audits the log across the resume)
+    # a resumed run must CONTINUE the prior run's logs, not truncate
+    # them (mirrors cli/run.py's make_app_from_args; post-run validation
+    # audits the logs across the resume)
     checkpoint_path = getattr(args, "checkpoint", None)
     resuming = bool(checkpoint_path) and os.path.exists(checkpoint_path)
     log = CsvLogSink("./logs-server.csv" if args.logging else None,
                      SERVER_HEADER, append=resuming)
+    # events persist incrementally — an end-of-run dump would lose the
+    # auditor's eviction/readmission record on a crash
+    events_log = (CsvLogSink("./logs-events.csv", EVENTS_HEADER,
+                             append=resuming)
+                  if args.logging else NullLogSink())
+    # the logical-run id the bridge advertises (T_CONFIG): a resume
+    # continues the checkpointed run, a fresh start mints a new one —
+    # worker processes match their local state files against it
+    run_id = None
+    if resuming:
+        from kafka_ps_tpu.utils import checkpoint as ckpt
+        run_id = ckpt.peek_run_id(checkpoint_path)
+    if run_id is None:
+        run_id = time.time_ns()
     bridge = net.ServerBridge(
         port=args.listen,
         heartbeat_interval=min(1.0, hb_timeout / 3) if hb_timeout else 1.0,
-        heartbeat_timeout=hb_timeout)
+        heartbeat_timeout=hb_timeout,
+        run_id=run_id)
     print(f"listening on port {bridge.port}", file=sys.stderr, flush=True)
     fabric = bridge.wrap(fabric_mod.Fabric())
     server = ServerNode(cfg, fabric, test_x, test_y, log)
+    server.run_id = run_id
+    server.membership_log = events_log   # before restore: it logs "resume"
 
     if checkpoint_path:
         from kafka_ps_tpu.utils import checkpoint as ckpt
@@ -204,6 +206,10 @@ def run_server(args) -> int:
                                      timeout=0.2)
             if g is not None:
                 server.process(g)
+    except KeyboardInterrupt:
+        # mirror cli/run.py: Ctrl-C is an orderly shutdown — the
+        # finally block still checkpoints and flushes logs/events
+        print("interrupted — shutting down", file=sys.stderr, flush=True)
     finally:
         bridge.close()       # workers see EOF and shut down
         if checkpoint_path:
@@ -212,9 +218,7 @@ def run_server(args) -> int:
         if reroute["dropped"] or bridge.dropped_sends:
             print(f"dropped rows: {reroute['dropped']}, dropped sends: "
                   f"{bridge.dropped_sends}", file=sys.stderr, flush=True)
-        if args.logging and server.membership_events:
-            write_events_log("./logs-events.csv", server.membership_events,
-                             append=resuming)
+        events_log.close()
         log.close()
     return 0
 
@@ -231,17 +235,68 @@ def run_worker(args) -> int:
     cfg = _make_cfg(args)
     test_x, test_y = load_test_csv(args.test_data_file_path,
                                    args.num_features)
-    log = CsvLogSink("./logs-worker.csv" if args.logging else None,
-                     WORKER_HEADER)
 
+    # connect FIRST: the handshake (net.T_CONFIG) carries the server's
+    # logical-run id, which decides whether local state is valid below
     bridge = net.WorkerBridge(
         host or "127.0.0.1", int(port), ids,
         heartbeat_timeout=getattr(args, "heartbeat_timeout", None))
     fabric = bridge.make_fabric()
+
+    # worker-local durable state (utils/checkpoint.py): the per-process
+    # analogue of the reference's changelog-backed store restore
+    # (WorkerApp.java:40-42) — a worker process restarted WITHIN a run
+    # recovers its training window instead of cold-starting an empty
+    # buffer.  State written under a different run (the server started
+    # fresh since) is stale: restoring it would seed this run with the
+    # old run's rows and append to a log the server side truncated.
+    state_path = None
+    restoring = False
+    if getattr(args, "checkpoint", None):
+        from kafka_ps_tpu.utils import checkpoint as ckpt
+        state_path = ckpt.worker_state_path(args.checkpoint, ids)
+        stored = ckpt.peek_run_id(state_path)
+        restoring = stored is not None and stored == bridge.server_run_id
+        if not restoring and os.path.exists(state_path):
+            print(f"discarding stale worker state {state_path} "
+                  f"(run {stored} != server run {bridge.server_run_id})",
+                  file=sys.stderr, flush=True)
+            os.remove(state_path)
+    log = CsvLogSink("./logs-worker.csv" if args.logging else None,
+                     WORKER_HEADER, append=restoring)
+
     buffers = {w: SlidingBuffer(cfg.model.num_features, cfg.buffer)
                for w in ids}
+    if restoring:
+        from kafka_ps_tpu.utils import checkpoint as ckpt
+        if ckpt.maybe_restore_worker(state_path, buffers,
+                                     run_id=bridge.server_run_id):
+            print("restored worker buffers: " + ", ".join(
+                f"{w}:{buffers[w].count} rows (seen "
+                f"{buffers[w].num_tuples_seen})" for w in ids),
+                file=sys.stderr, flush=True)
     nodes = {w: WorkerNode(w, cfg, fabric, buffers[w], test_x, test_y, log)
              for w in ids}
+
+    if state_path is not None:
+        from kafka_ps_tpu.utils import checkpoint as ckpt
+        state_stop = threading.Event()
+
+        def state_saver():
+            # the changelog analogue: snapshot on a cadence so a
+            # SIGKILL'd process loses at most one interval of rows;
+            # skip idle intervals (no new insertions = same slab)
+            last = None
+            while not state_stop.wait(1.0):
+                fp = tuple(buffers[w].num_tuples_seen for w in ids)
+                if fp != last:
+                    ckpt.save_worker(state_path, buffers,
+                                     run_id=bridge.server_run_id)
+                    last = fp
+
+        state_saver_thread = threading.Thread(
+            target=state_saver, daemon=True, name="kps-worker-state")
+        state_saver_thread.start()
 
     threading.Thread(target=bridge.run_reader, args=(buffers,),
                      daemon=True, name="kps-worker-reader").start()
@@ -284,6 +339,18 @@ def run_worker(args) -> int:
     stop.set()
     for t in threads:
         t.join(timeout=5.0)
+    if state_path is not None:
+        from kafka_ps_tpu.utils import checkpoint as ckpt
+        state_stop.set()
+        # join BEFORE the final save: two concurrent save_worker calls
+        # share one tmp path and would corrupt the state file
+        state_saver_thread.join(timeout=10.0)
+        if state_saver_thread.is_alive():   # wedged in a stalled write
+            print("warning: state saver still writing; skipping final "
+                  "snapshot", file=sys.stderr, flush=True)
+        else:
+            ckpt.save_worker(state_path, buffers,   # final snapshot
+                             run_id=bridge.server_run_id)
     log.close()
     bridge.close()
     if errors:

@@ -140,3 +140,34 @@ class SlidingBuffer:
         with self._lock:
             mask = (self.insertion_id > 0).astype(np.float32)
             return self.x.copy(), self.y.copy(), mask
+
+    # -- durability (utils/checkpoint.py) ----------------------------------
+
+    def state(self) -> dict[str, np.ndarray]:
+        """Serializable durable state: slab contents, insertion IDs, and
+        the inter-arrival window behind the rate-adaptive target size —
+        the changelog-backed state store the reference's workers restore
+        from on reassignment (WorkerApp.java:40-42, Kafka Streams
+        logged KV store)."""
+        with self._lock:
+            return {"x": self.x.copy(), "y": self.y.copy(),
+                    "ids": self.insertion_id.copy(),
+                    "arrivals": np.asarray(self._inter_arrival_ms,
+                                           dtype=np.float64)}
+
+    def restore_state(self, st) -> None:
+        """Inverse of state().  The arrival CLOCK does not survive a
+        restart (monotonic time is process-local), so the gap between
+        the crash and the first post-restore arrival is not counted as
+        an inter-arrival — only the restored window is."""
+        if st["x"].shape != self.x.shape:
+            raise ValueError(
+                f"buffer state shape {st['x'].shape} != slab "
+                f"{self.x.shape} (capacity/features changed?)")
+        with self._lock:
+            self.x[:] = st["x"]
+            self.y[:] = st["y"]
+            self.insertion_id[:] = st["ids"]
+            self._inter_arrival_ms.clear()
+            self._inter_arrival_ms.extend(float(v) for v in st["arrivals"])
+            self._last_arrival_ms = None

@@ -42,7 +42,11 @@ class Violation:
     detail: str
 
 
-MembershipEvent = tuple[int, str, int]    # (timestamp_ms, kind, worker)
+#: (timestamp_ms, kind, worker) — kind is "evict" / "readmit" (worker
+#: membership, ServerNode.remove_worker/readmit_worker) or "resume"
+#: (checkpoint restore, worker = -1: every worker may re-log its last
+#: clock once, the at-least-once redelivery of utils/checkpoint.py)
+MembershipEvent = tuple[int, str, int]
 
 
 def validate_worker_log(worker_df: pd.DataFrame,
@@ -176,10 +180,41 @@ def _validate_elastic_epochs(worker_df: pd.DataFrame,
                     f"spread {spread} > bound {bound} at timestamp "
                     f"{ts} (clocks {dict(sorted(latest.items()))})"))
 
+    # workers whose NEXT row follows a checkpoint resume: the crash
+    # killed the in-flight messages and the restored server re-sends
+    # each worker's CHECKPOINTED clock (at-least-once redelivery,
+    # utils/checkpoint.py restore) — which a crash resume rewinds to
+    # the last periodic save, below rows the surviving log already
+    # holds.  That one row per worker may carry ANY clock, and the
+    # pre-crash `latest` clocks are dead state (comparing rewound rows
+    # against them would fake a staleness spread), so they leave the
+    # spread until each worker's redelivered row re-enters it.
+    resumed: set[int] = set()
+
     for ts, kind_order, item in timeline:
         if kind_order == 0:             # membership event
             _, kind, w = item
             w = int(w)
+            if kind == "resume":
+                # a crash resume rewinds the SERVER'S state — including
+                # membership — to the last periodic save, which the
+                # append-only events log cannot see.  All pre-resume
+                # membership bookkeeping is void: a worker evicted
+                # after that save is revived by the restore (its
+                # checkpointed active flag) and legally logs again.
+                # Bias to no false positives: treat every known worker
+                # as active with one any-clock redelivery; post-resume
+                # evict/readmit events re-segment from here.
+                known = active | set(latest) | set(frozen)
+                resumed |= known
+                active |= known
+                frozen.clear()
+                latest.clear()
+                pending_readmit.clear()
+                early_claims.clear()
+                for w_, times in readmit_times.items():
+                    readmit_times[w_] = [t for t in times if t > ts]
+                continue
             if kind == "evict":
                 active.discard(w)
                 if w in latest:         # frozen clock leaves the spread
@@ -190,7 +225,8 @@ def _validate_elastic_epochs(worker_df: pd.DataFrame,
                 # row would be misread as a rejoin and its frozen clock
                 # would re-enter the spread permanently
                 for _ in range(pending_readmit.get(w, 0)):
-                    readmit_times[w].pop(0)
+                    if readmit_times.get(w):
+                        readmit_times[w].pop(0)
                 pending_readmit[w] = 0
             elif early_claims.get(w, 0) > 0:
                 early_claims[w] -= 1    # a skew-sorted row already took it
@@ -250,7 +286,13 @@ def _validate_elastic_epochs(worker_df: pd.DataFrame,
                         "(epoch validation assumes NTP-synced hosts)")
             continue
         prev = latest.get(w)
-        if prev is not None and clock != prev + 1:
+        if w in resumed:
+            # any clock is legal on the one redelivered row: a crash
+            # resume restarts from the last PERIODIC save, so the clock
+            # can regress below rows the surviving log already holds
+            # (and then legitimately re-walk them, +1 from here)
+            resumed.discard(w)
+        elif prev is not None and clock != prev + 1:
             out.append(Violation(
                 "clock-step",
                 f"worker {w}: clock {prev} -> {clock} "
@@ -260,14 +302,30 @@ def _validate_elastic_epochs(worker_df: pd.DataFrame,
     return out
 
 
-def validate_server_log(server_df: pd.DataFrame) -> list[Violation]:
+def validate_server_log(server_df: pd.DataFrame,
+                        membership_events: list[MembershipEvent] | None = None
+                        ) -> list[Violation]:
+    """The server's eval clock never regresses — except across a
+    checkpoint resume (a "resume" membership event), where a crash
+    restart legitimately rewinds to the last periodic save and re-walks
+    the lost iterations."""
     out: list[Violation] = []
-    clocks = server_df["vectorClock"].tolist()
-    for prev, cur in zip(clocks, clocks[1:]):
-        if cur < prev:
-            out.append(Violation(
-                "server-clock-regression",
-                f"server eval clock {prev} -> {cur}"))
+    resume_ts = sorted(ts for ts, kind, _ in (membership_events or [])
+                       if kind == "resume")
+    ordered = server_df.sort_values("timestamp", kind="stable")
+    prev_clock = prev_ts = None
+    for _, row in ordered.iterrows():
+        ts, cur = int(row["timestamp"]), int(row["vectorClock"])
+        if prev_clock is not None and cur < prev_clock:
+            crossed = any(prev_ts <= r <= ts for r in resume_ts)
+            if crossed:
+                resume_ts = [r for r in resume_ts
+                             if not (prev_ts <= r <= ts)]
+            else:
+                out.append(Violation(
+                    "server-clock-regression",
+                    f"server eval clock {prev_clock} -> {cur}"))
+        prev_clock, prev_ts = cur, ts
     return out
 
 
@@ -283,13 +341,15 @@ def validate_run(worker_df: pd.DataFrame | None,
                                    elastic=elastic,
                                    membership_events=membership_events)
     if server_df is not None and len(server_df):
-        out += validate_server_log(server_df)
+        out += validate_server_log(server_df,
+                                   membership_events=membership_events)
     return out
 
 
 def load_membership_events(path: str) -> list[MembershipEvent]:
-    """Parse a logs-events.csv (`timestamp;event;partition`, written by
-    cli/socket_mode.write_events_log)."""
+    """Parse a logs-events.csv (`timestamp;event;partition`, written
+    incrementally by ServerNode.record_membership_event through the
+    events CsvLogSink the CLIs install — csvlog.EVENTS_HEADER)."""
     df = pd.read_csv(path, sep=";")
     return [(int(r["timestamp"]), str(r["event"]), int(r["partition"]))
             for _, r in df.iterrows()]

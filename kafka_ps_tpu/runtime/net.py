@@ -40,7 +40,6 @@ from kafka_ps_tpu.runtime import serde
 _FRAME = struct.Struct("<IBq")          # length, topic, key
 (T_WEIGHTS, T_GRADIENTS, T_DATA, T_HELLO, T_READY,
  T_PING, T_PONG, T_CONFIG) = 1, 2, 3, 4, 5, 6, 7, 8
-_CONFIG_GRACE = 10.0    # read timeout until T_CONFIG arrives (s)
 _TOPIC_NAMES = {T_WEIGHTS: fabric_mod.WEIGHTS_TOPIC,
                 T_GRADIENTS: fabric_mod.GRADIENTS_TOPIC,
                 T_DATA: fabric_mod.INPUT_DATA_TOPIC}
@@ -120,7 +119,14 @@ class ServerBridge:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  heartbeat_interval: float | None = None,
-                 heartbeat_timeout: float | None = None):
+                 heartbeat_timeout: float | None = None,
+                 run_id: int = 0):
+        # `run_id` identifies the logical RUN (fresh server start, or
+        # the run a checkpoint resume continues — utils/checkpoint.py
+        # persists it).  Advertised in T_CONFIG so worker processes can
+        # tell whether their local state file belongs to THIS run or is
+        # a stale leftover from an earlier one (cli/socket_mode.py).
+        self.run_id = run_id
         self._listener = socket.create_server((host, port))
         self.port = self._listener.getsockname()[1]
         self._conn_of: dict[int, socket.socket] = {}   # worker -> conn
@@ -274,15 +280,21 @@ class ServerBridge:
                 if topic == T_HELLO:
                     (n,) = struct.unpack_from("<q", payload, 0)
                     ids = struct.unpack_from(f"<{n}q", payload, 8)
+                    # T_CONFIG goes out BEFORE the ids are registered:
+                    # once registered, the producer thread may race data
+                    # rows onto this connection, and the worker-side
+                    # handshake relies on T_CONFIG being the first
+                    # non-PING frame (per-connection FIFO).  Payload:
+                    # PING cadence (0.0 = no heartbeats; the worker must
+                    # not time out at all) + the run id.
+                    self._send_raw(conn, T_CONFIG, 0,
+                                   struct.pack("<dq",
+                                               self._hb_interval or 0.0,
+                                               self.run_id))
                     with self._cv:
                         for w in ids:
                             self._conn_of[w] = conn
                         self._cv.notify_all()
-                    # advertise the PING cadence so the worker can floor
-                    # its read timeout instead of guessing (0.0 = no
-                    # heartbeats; the worker must not time out at all)
-                    self._send_raw(conn, T_CONFIG, 0,
-                                   struct.pack("<d", self._hb_interval or 0.0))
                     if self.on_hello is not None:
                         self.on_hello(list(ids))
                 elif topic == T_READY:
@@ -348,27 +360,46 @@ class WorkerBridge:
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.2)
-        # a half-open server link surfaces as socket.timeout in the read
-        # loop (TimeoutError is an OSError: same exit path as a reset).
-        # Until the server advertises its ping cadence (T_CONFIG) the
-        # flag value cannot be trusted — a sub-ping timeout applied now
-        # would false-declare the server dead before the first ping —
-        # so the pre-config window gets a generous grace instead
-        if heartbeat_timeout is not None:
-            self._sock.settimeout(max(heartbeat_timeout, _CONFIG_GRACE))
-        else:
-            # clear the 5 s connect timeout create_connection left on
-            # the socket: with no heartbeat flag the worker must block
-            # on a quiet-but-alive server indefinitely
-            self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._send_lock = threading.Lock()
         self._stop = threading.Event()
         self.disconnected = threading.Event()
+        self.server_run_id: int | None = None
         payload = struct.pack(f"<q{len(self.worker_ids)}q",
                               len(self.worker_ids), *self.worker_ids)
         with self._send_lock:
             send_frame(self._sock, T_HELLO, 0, payload)
+        # synchronous handshake: the server replies T_CONFIG before it
+        # registers our ids (net.ServerBridge._reader), so it is the
+        # first non-PING frame on the wire — read it HERE, before any
+        # reader thread exists, so callers know the server's run id and
+        # ping cadence before deciding what local state to restore
+        self._sock.settimeout(10.0)
+        try:
+            while True:
+                frame = recv_frame(self._sock)
+                if frame is None:
+                    raise ConnectionError("server closed during handshake")
+                topic, _key, pl = frame
+                if topic == T_PING:
+                    with self._send_lock:
+                        send_frame(self._sock, T_PONG, 0)
+                    continue
+                if topic == T_CONFIG:
+                    interval, run_id = struct.unpack_from("<dq", pl, 0)
+                    self.server_run_id = int(run_id)
+                    break
+                raise ConnectionError(
+                    f"expected T_CONFIG during handshake, got topic {topic}")
+        except socket.timeout as e:
+            raise ConnectionError("no T_CONFIG from server") from e
+        # steady state: the configured read timeout (a half-open server
+        # link then surfaces as socket.timeout in the read loop —
+        # TimeoutError is an OSError, same exit path as a reset), or
+        # blocking forever when no timeout was requested; the advertised
+        # cadence may floor or disable it
+        self._sock.settimeout(heartbeat_timeout)
+        self._apply_server_ping_interval(interval)
 
     def make_fabric(self) -> fabric_mod.Fabric:
         """Local fabric whose GRADIENTS sends cross the socket (the
@@ -388,8 +419,9 @@ class WorkerBridge:
         return self.fabric
 
     def _apply_server_ping_interval(self, interval: float) -> None:
-        """React to the server's advertised PING cadence (T_CONFIG, sent
-        right after HELLO).  The worker's `heartbeat_timeout` and the
+        """React to the server's advertised PING cadence (T_CONFIG,
+        consumed in the constructor handshake right after HELLO).  The
+        worker's `heartbeat_timeout` and the
         server's ping interval are independent flags in different
         processes; a timeout below a few pings false-declares a healthy
         server dead and kills the whole worker process (ADVICE r3) — so
@@ -431,7 +463,10 @@ class WorkerBridge:
                         send_frame(self._sock, T_PONG, 0)
                     continue
                 if topic == T_CONFIG:
-                    (interval,) = struct.unpack_from("<d", payload, 0)
+                    # normally consumed by the constructor handshake;
+                    # tolerate a re-sent config mid-stream (same <dq>
+                    # decode — run id changes are not acted on)
+                    (interval, _rid) = struct.unpack_from("<dq", payload, 0)
                     self._apply_server_ping_interval(interval)
                     continue
                 msg = serde.from_bytes(payload)

@@ -72,10 +72,22 @@ class ServerNode:
         self.checkpoint_path: str | None = None
         self.checkpoint_every: int = 50   # <= 0: only save on exit
         self._last_checkpoint_iteration = 0
-        # membership-change record (timestamp_ms, "evict"|"readmit",
-        # worker) — the audit trail the staleness auditor segments
-        # elastic runs by (evaluation/validate.py epoch checking)
+        # in-process runs fold the workers' buffers into the checkpoint
+        # (durable training window); split mode leaves this None — each
+        # worker process persists its own state file instead
+        self.checkpoint_buffers = None
+        # logical-run identity: survives checkpoint resumes (restore
+        # overwrites it), changes on every fresh start — worker-local
+        # state files are only valid within the run that wrote them
+        self.run_id = time.time_ns()
+        # membership-change record (timestamp_ms, "evict"|"readmit"|
+        # "resume", worker) — the audit trail the staleness auditor
+        # segments elastic runs by (evaluation/validate.py epoch
+        # checking).  `membership_log` (a CsvLogSink) persists each
+        # event AS IT HAPPENS: an end-of-run write would lose the
+        # record on a crash — the very scenario the events exist for
         self.membership_events: list[tuple[int, str, int]] = []
+        self.membership_log = None
 
     # -- bootstrap (ServerProcessor.java:75-87) ----------------------------
 
@@ -148,12 +160,17 @@ class ServerNode:
     # rebalancing + k8s pod restarts, SURVEY §5); here they are runtime
     # APIs driven by the supervisor in runtime/app.py.
 
+    def record_membership_event(self, kind: str, worker: int) -> None:
+        ev = (int(time.time() * 1000), kind, worker)
+        self.membership_events.append(ev)
+        if self.membership_log is not None:
+            self.membership_log(f"{ev[0]};{kind};{worker}")
+
     def remove_worker(self, worker: int) -> None:
         """Evict a failed worker: every consistency gate stops waiting
         for its gradients, and any round it was blocking is released."""
         self.tracker.deactivate_worker(worker)
-        self.membership_events.append(
-            (int(time.time() * 1000), "evict", worker))
+        self.record_membership_event("evict", worker)
         self.tracer.count("server.workers_removed")
         self._flush_gate()
 
@@ -167,8 +184,7 @@ class ServerNode:
                           lambda m: getattr(m, "worker_id", None) == worker)
         self.fabric.purge(fabric_mod.WEIGHTS_TOPIC, worker, lambda m: True)
         clock = self.tracker.reactivate_worker(worker)
-        self.membership_events.append(
-            (int(time.time() * 1000), "readmit", worker))
+        self.record_membership_event("readmit", worker)
         self.tracer.count("server.workers_readmitted")
         self.send_weights(worker, clock)
         return clock
@@ -228,5 +244,6 @@ class ServerNode:
         if (self.iterations - self._last_checkpoint_iteration
                 >= self.checkpoint_every):
             from kafka_ps_tpu.utils import checkpoint as ckpt
-            ckpt.save(self.checkpoint_path, self)
+            ckpt.save(self.checkpoint_path, self,
+                      buffers=self.checkpoint_buffers)
             self._last_checkpoint_iteration = self.iterations

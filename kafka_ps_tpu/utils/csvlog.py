@@ -14,6 +14,10 @@ import threading
 
 SERVER_HEADER = "timestamp;partition;vectorClock;loss;fMeasure;accuracy"
 WORKER_HEADER = SERVER_HEADER + ";numTuplesSeen"
+# membership/audit events (evict / readmit / resume) — written
+# INCREMENTALLY as they happen so a crash cannot lose the record the
+# staleness auditor segments elastic runs by (evaluation/validate.py)
+EVENTS_HEADER = "timestamp;event;partition"
 
 
 class NullLogSink:

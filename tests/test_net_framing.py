@@ -138,6 +138,13 @@ def test_worker_bridge_pongs_keep_connection_alive():
     worker.close(), bridge.close()
 
 
+def test_handshake_carries_run_id():
+    bridge = net.ServerBridge(run_id=987654321)
+    worker = _connect_worker(bridge.port, [1])
+    assert worker.server_run_id == 987654321
+    worker.close(), bridge.close()
+
+
 def test_default_worker_has_no_read_timeout():
     """With no --heartbeat_timeout the worker must block on a quiet
     server forever — create_connection's 5 s connect timeout must not

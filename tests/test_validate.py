@@ -224,6 +224,76 @@ def test_epochs_first_row_of_preevicted_worker_is_not_a_rejoin():
     assert _elastic(rows, events, k=0) == []
 
 
+def test_epochs_resume_allows_one_redelivery_per_worker():
+    """A restored server re-sends each worker's current clock
+    (at-least-once redelivery): the first post-resume row may repeat or
+    jump past the pre-crash clock — exactly once per worker."""
+    rows = [(0, 0, 0), (1, 1, 0), (2, 0, 1), (3, 1, 1),
+            # resume at 50: both workers re-log their last clock
+            (60, 0, 1), (61, 1, 1), (62, 0, 2), (63, 1, 2)]
+    events = [(50, "resume", -1)]
+    assert _elastic(rows, events, k=0) == []
+    # a SECOND repeat is a real duplicate-iteration bug, still caught
+    bad = rows + [(64, 0, 2)]
+    v = validate.validate_worker_log(_wdf(bad), 0, elastic=True,
+                                     membership_events=events)
+    assert any(x.rule == "clock-step" for x in v)
+
+
+def test_epochs_resume_allows_crash_rewind_then_rewalk():
+    """A crash resume restarts from the last PERIODIC save: the clock
+    legally regresses below rows the surviving log already holds, then
+    re-walks them +1 — a second unexempted jump is still a bug."""
+    rows = [(0, 0, 0), (1, 0, 1), (2, 0, 2),
+            (60, 0, 1), (61, 0, 2), (62, 0, 3)]   # rewind + re-walk
+    events = [(50, "resume", -1)]
+    assert _elastic(rows, events, k=0) == []
+    bad = rows + [(63, 0, 1)]          # regression with no resume event
+    v = validate.validate_worker_log(_wdf(bad), 0, elastic=True,
+                                     membership_events=events)
+    assert any(x.rule == "clock-step" for x in v)
+
+
+def test_epochs_resume_quarantines_stale_spread():
+    """Crash rewind with 2+ workers: redelivered clocks must be checked
+    against each other, not against dead pre-crash `latest` entries —
+    else every rewind deeper than the bound reads as a violation."""
+    rows = [(0, 0, 0), (1, 1, 0), (2, 0, 1), (3, 1, 1),
+            (4, 0, 2), (5, 1, 2), (6, 0, 3), (7, 1, 3),
+            # checkpoint was at clock 1; crash; resume rewinds both
+            (60, 0, 1), (61, 1, 1), (62, 0, 2), (63, 1, 2)]
+    events = [(50, "resume", -1)]
+    assert _elastic(rows, events, k=0) == []
+
+
+def test_epochs_resume_revives_workers_evicted_after_checkpoint():
+    """A crash resume rewinds MEMBERSHIP too: a worker evicted after
+    the last periodic save is restored active and legally logs again —
+    the append-only evict event must not keep it out of the audit."""
+    rows = [(0, 0, 0), (1, 1, 0), (2, 0, 1), (3, 1, 1),
+            (5, 0, 2), (7, 0, 3),              # survivor runs ahead
+            # crash; resume from a PRE-eviction checkpoint (clock 1/1)
+            (60, 0, 1), (61, 1, 1), (62, 0, 2), (63, 1, 2)]
+    events = [(4, "evict", 1), (50, "resume", -1)]
+    assert _elastic(rows, events, k=0) == []
+
+
+def test_server_log_regression_exempted_across_resume():
+    def sdf(rows):
+        return pd.DataFrame([{"timestamp": t, "partition": -1,
+                              "vectorClock": c, "loss": 0, "fMeasure": 0,
+                              "accuracy": 0} for t, c in rows])
+    rows = [(0, 10), (1, 11), (60, 5), (61, 6)]   # crash rewind at 50
+    events = [(50, "resume", -1)]
+    assert validate.validate_server_log(sdf(rows), events) == []
+    # without the event the regression is still a violation
+    v = validate.validate_server_log(sdf(rows))
+    assert len(v) == 1 and v[0].rule == "server-clock-regression"
+    # a second regression with no matching resume is caught
+    v2 = validate.validate_server_log(sdf(rows + [(70, 2)]), events)
+    assert len(v2) == 1 and v2[0].rule == "server-clock-regression"
+
+
 def test_epochs_late_last_gasp_warns():
     """A +1-chain row arriving implausibly long after the eviction is
     tolerated but flagged as possible clock skew."""
