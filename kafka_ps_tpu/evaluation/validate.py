@@ -314,8 +314,9 @@ def validate_server_log(server_df: pd.DataFrame,
                        if kind == "resume")
     ordered = server_df.sort_values("timestamp", kind="stable")
     prev_clock = prev_ts = None
-    for _, row in ordered.iterrows():
-        ts, cur = int(row["timestamp"]), int(row["vectorClock"])
+    for ts, cur in zip(ordered["timestamp"].tolist(),
+                       ordered["vectorClock"].tolist()):
+        ts, cur = int(ts), int(cur)
         if prev_clock is not None and cur < prev_clock:
             crossed = any(prev_ts <= r <= ts for r in resume_ts)
             if crossed:

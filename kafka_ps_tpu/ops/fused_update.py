@@ -24,8 +24,9 @@ models/logreg — at the reference's shapes (B≤1024, F=1024, C=5) the
 whole problem fits on-chip.
 
 Measured A/B (bench.py, interleaved pipelined dispatch, TPU v5e,
-B=1024 F=1024 k=2, BENCH_r02): 926 pallas vs 907 XLA local-updates/s —
-**1.02x, i.e. parity**.  SURVEY §7 predicted this: at 6150 parameters
+B=1024 F=1024 k=2, BENCH_r03): 972.1 pallas vs 981.3 XLA
+local-updates/s — **0.99x, i.e. parity** (BENCH_r02 recorded the same:
+817.8 vs 812.5, 1.006x).  SURVEY §7 predicted this: at 6150 parameters
 XLA already fuses the whole k-step loop well, so the kernel earns its
 keep only as the explicit-VMEM-residency form of the op (single
 pallas_call holding the solver loop on-chip) for shapes near the VMEM
