@@ -110,8 +110,9 @@ def run_server(args) -> int:
         heartbeat_timeout=hb_timeout,
         run_id=run_id)
     print(f"listening on port {bridge.port}", file=sys.stderr, flush=True)
+    from kafka_ps_tpu.utils.asynclog import DeferredSink
     fabric = bridge.wrap(fabric_mod.Fabric())
-    server = ServerNode(cfg, fabric, test_x, test_y, log)
+    server = ServerNode(cfg, fabric, test_x, test_y, DeferredSink(log))
     server.run_id = run_id
     server.membership_log = events_log   # before restore: it logs "resume"
 
@@ -218,6 +219,7 @@ def run_server(args) -> int:
         if reroute["dropped"] or bridge.dropped_sends:
             print(f"dropped rows: {reroute['dropped']}, dropped sends: "
                   f"{bridge.dropped_sends}", file=sys.stderr, flush=True)
+        server.log.flush()           # deferred eval lines out first
         events_log.close()
         log.close()
     return 0
@@ -275,7 +277,10 @@ def run_worker(args) -> int:
                 f"{w}:{buffers[w].count} rows (seen "
                 f"{buffers[w].num_tuples_seen})" for w in ids),
                 file=sys.stderr, flush=True)
-    nodes = {w: WorkerNode(w, cfg, fabric, buffers[w], test_x, test_y, log)
+    from kafka_ps_tpu.utils.asynclog import DeferredSink
+    worker_log = DeferredSink(log)
+    nodes = {w: WorkerNode(w, cfg, fabric, buffers[w], test_x, test_y,
+                           worker_log)
              for w in ids}
 
     if state_path is not None:
@@ -351,6 +356,7 @@ def run_worker(args) -> int:
         else:
             ckpt.save_worker(state_path, buffers,   # final snapshot
                              run_id=bridge.server_run_id)
+    worker_log.flush()               # deferred lines out before close
     log.close()
     bridge.close()
     if errors:

@@ -31,6 +31,7 @@ from kafka_ps_tpu.parallel import bsp
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime.server import LogSink, ServerNode
 from kafka_ps_tpu.runtime.worker import WorkerNode
+from kafka_ps_tpu.utils.asynclog import DeferredSink
 from kafka_ps_tpu.utils.config import PSConfig, SEQUENTIAL
 from kafka_ps_tpu.utils.trace import NULL_TRACER
 
@@ -52,6 +53,11 @@ class StreamingPSApp:
         self.buffers = [
             SlidingBuffer(cfg.model.num_features, cfg.buffer, clock_ms=clock_ms)
             for _ in range(cfg.num_workers)]
+        # deferred sinks: the per-node hot path logs device futures
+        # (loss/F1/accuracy) without blocking on them — flushed when
+        # ready and force-flushed at drive-loop exit (utils/asynclog)
+        server_log = DeferredSink(server_log or (lambda line: None))
+        worker_log = DeferredSink(worker_log or (lambda line: None))
         self.server = ServerNode(cfg, self.fabric, test_x, test_y, server_log,
                                  tracer=self.tracer)
         self.workers = [
@@ -121,6 +127,15 @@ class StreamingPSApp:
 
     # -- drive loops -------------------------------------------------------
 
+    def flush_logs(self) -> None:
+        """Force every deferred log line out (blocks on the device) —
+        drive loops call this on exit so callers see complete logs."""
+        for sink in (self.server.log, *{id(w.log): w.log
+                                        for w in self.workers}.values()):
+            flush = getattr(sink, "flush", None)
+            if flush is not None:
+                flush()
+
     def run_serial(self, max_server_iterations: int,
                    pump=None) -> None:
         """Deterministic scheduler: alternate weights delivery / gradient
@@ -129,28 +144,31 @@ class StreamingPSApp:
         between rounds."""
         self.server.start_training_loop()
         stalled_rounds = 0
-        while self.server.iterations < max_server_iterations:
-            progressed = False
-            for worker in self.workers:
-                msg = self.fabric.poll(fabric_mod.WEIGHTS_TOPIC,
-                                       worker.worker_id)
-                if msg is not None:
-                    worker.on_weights(msg)
-                    progressed = True
+        try:
             while self.server.iterations < max_server_iterations:
-                g = self.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0)
-                if g is None:
-                    break
-                self.server.process(g)
-                progressed = True
-            if pump is not None:
-                pump()
-            # pump() can only add buffer rows, never fabric messages, so a
-            # stretch of unprogressed rounds is a protocol deadlock even
-            # with a pump attached.
-            stalled_rounds = 0 if progressed else stalled_rounds + 1
-            if stalled_rounds > (1000 if pump is not None else 0):
-                raise RuntimeError("deadlock: no deliverable messages")
+                progressed = False
+                for worker in self.workers:
+                    msg = self.fabric.poll(fabric_mod.WEIGHTS_TOPIC,
+                                           worker.worker_id)
+                    if msg is not None:
+                        worker.on_weights(msg)
+                        progressed = True
+                while self.server.iterations < max_server_iterations:
+                    g = self.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0)
+                    if g is None:
+                        break
+                    self.server.process(g)
+                    progressed = True
+                if pump is not None:
+                    pump()
+                # pump() can only add buffer rows, never fabric messages,
+                # so a stretch of unprogressed rounds is a protocol
+                # deadlock even with a pump attached.
+                stalled_rounds = 0 if progressed else stalled_rounds + 1
+                if stalled_rounds > (1000 if pump is not None else 0):
+                    raise RuntimeError("deadlock: no deliverable messages")
+        finally:
+            self.flush_logs()
 
     def run_threaded(self, max_server_iterations: int,
                      poll_timeout: float = 0.1,
@@ -265,6 +283,7 @@ class StreamingPSApp:
             self._stop.set()
             for t in threads.values():
                 t.join(timeout=5.0)
+            self.flush_logs()
         if worker_errors:
             raise RuntimeError("worker thread failed") from worker_errors[0]
 
@@ -381,12 +400,13 @@ class StreamingPSApp:
             self.tracer.count("bsp.steps")
             clock += 1
             self.server.iterations += len(active)
-            # np.array (copy): an asarray view of a JAX array is
-            # read-only and the message path mutates theta in place
+            # theta is updated by replacement everywhere (runtime/server
+            # module doc), so the device array is stored directly — no
+            # per-step device->host copy
             if range_mode:
                 self.server.theta = range_sharded.unshard_theta(theta, task)
             else:
-                self.server.theta = np.array(theta)
+                self.server.theta = theta
             for w in active:
                 self.workers[w].iterations += 1
                 self.server.tracker.tracker[w].vector_clock = clock

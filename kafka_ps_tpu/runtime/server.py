@@ -1,11 +1,16 @@
 """The parameter server — behavioral re-design of ServerProcessor
 (processors/ServerProcessor.java:31-229).
 
-State: the flat parameter vector (host numpy — 6150 floats of control
-state; all heavy math runs jit'd on device), a MessageTracker, and the
-consistency gate.  Aggregation: theta[range] += server_lr * delta with
-server_lr defaulting to 1/num_workers, making the BSP update the average
-of worker deltas (ServerProcessor.java:36,225-228).
+State: the flat parameter vector (device-resident, updated by
+REPLACEMENT — never mutated in place, so weights messages, evals and
+checkpoints can all alias the immutable array), a MessageTracker, and
+the consistency gate.  Aggregation: theta[range] += server_lr * delta
+with server_lr defaulting to 1/num_workers, making the BSP update the
+average of worker deltas (ServerProcessor.java:36,225-228).  Full-range
+gradients (the per-node protocol) apply as one jit'd add with no host
+synchronization; evaluation is an async dispatch whose results land in
+the log when they resolve (utils/asynclog.DeferredSink) — the gate
+never waits on an eval.
 
 Consistency dispatch (ServerProcessor.java:95-134):
   * eventual (-1): answer only the sender, immediately;
@@ -32,10 +37,10 @@ from typing import Callable
 import jax.numpy as jnp
 import numpy as np
 
-from kafka_ps_tpu.models import metrics as metrics_mod
 from kafka_ps_tpu.parallel.tracker import MessageTracker
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime.messages import GradientMessage, KeyRange, WeightsMessage
+from kafka_ps_tpu.utils import asynclog
 from kafka_ps_tpu.utils.config import EVENTUAL, PSConfig
 from kafka_ps_tpu.utils.trace import NULL_TRACER
 
@@ -56,9 +61,11 @@ class ServerNode:
         self.tracker = MessageTracker(cfg.num_workers)
         from kafka_ps_tpu.models.task import get_task
         self.task = get_task(cfg.task, cfg.model)
-        # np.array (not asarray): a JAX array view is read-only and the
-        # server mutates theta in place
-        self.theta = np.array(self.task.init_params(), dtype=np.float32)
+        # device-resident; updated by replacement only (see module doc)
+        self.theta = jnp.asarray(self.task.init_params(), dtype=jnp.float32)
+        import jax
+        self._apply_full = jax.jit(
+            lambda t, d: t + self.cfg.server_lr * d)
         self.test_x = jnp.asarray(test_x) if test_x is not None else None
         self.test_y = jnp.asarray(test_y) if test_y is not None else None
         self.log = log or (lambda line: None)
@@ -126,10 +133,15 @@ class ServerNode:
             self._flush_gate()
 
     def _weights_message(self, vector_clock: int) -> WeightsMessage:
+        # device theta is immutable — safe to alias; a host-side theta
+        # (checkpoint restore, partial-range splice) is copied so a
+        # later in-place edit can't race an in-flight message
+        values = (np.array(self.theta)
+                  if isinstance(self.theta, np.ndarray) else self.theta)
         return WeightsMessage(
             vector_clock=vector_clock,
             key_range=KeyRange(0, self.task.num_params),
-            values=self.theta.copy())
+            values=values)
 
     def send_weights(self, worker: int, clock: int) -> None:
         """The single weights-send site: dispatch + tracker bookkeeping +
@@ -213,7 +225,15 @@ class ServerNode:
         with self.tracer.span("server.apply", worker=msg.worker_id,
                               clock=msg.vector_clock):
             r = msg.key_range
-            self.theta[r.start:r.end] += self.cfg.server_lr * msg.values
+            if r.start == 0 and r.end == self.task.num_params:
+                # per-node protocol: one async jit'd add, no host sync
+                self.theta = self._apply_full(jnp.asarray(self.theta),
+                                              msg.values)
+            else:
+                host = np.array(self.theta)
+                host[r.start:r.end] += (self.cfg.server_lr
+                                        * np.asarray(msg.values))
+                self.theta = host
             self.iterations += 1
 
         if (msg.worker_id == 0 and self.test_x is not None
@@ -221,13 +241,14 @@ class ServerNode:
             with self.tracer.span("server.eval", clock=msg.vector_clock):
                 m = self.task.evaluate(jnp.asarray(self.theta), self.test_x,
                                        self.test_y)
-                m = metrics_mod.Metrics(*map(float, m))
-            self.last_metrics = m
+            self.last_metrics = m            # device futures; float() syncs
             # schema: timestamp;partition;vectorClock;loss;fMeasure;accuracy
             # (ServerAppRunner.java:81); partition=-1 like the reference,
             # loss = real test loss (reference hardcodes -1)
-            self.log(f"{int(time.time() * 1000)};-1;{msg.vector_clock};"
-                     f"{float(m.loss)};{float(m.f1)};{float(m.accuracy)}")
+            asynclog.submit_or_write(
+                self.log,
+                f"{int(time.time() * 1000)};-1;{msg.vector_clock};"
+                "{};{};{}", m.loss, m.f1, m.accuracy)
 
         for worker, clock in self.workers_to_respond_to(msg.vector_clock,
                                                         msg.worker_id):
