@@ -85,6 +85,13 @@ def build_parser(include_server_flags: bool = True,
                         "this many devices (2-D workers x params mesh — "
                         "the reference's latent KeyRange axis, "
                         "messages/KeyRange.java, parallel/range_sharded.py)")
+    p.add_argument("--status_every", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="emit a [status] line to stderr every N seconds "
+                        "(iters/s, per-worker clocks, membership, queue "
+                        "depths, buffer fill) — the live-observability "
+                        "stand-in for the reference's Confluent Control "
+                        "Center UI (utils/status.py; 0 = off)")
     p.add_argument("--trace", default=None, metavar="PATH",
                    help="write a Chrome trace-event JSON (spans + message "
                         "counters) on exit and print span stats — replaces "
@@ -315,24 +322,33 @@ def run_with_args(args) -> int:
     from kafka_ps_tpu.utils.trace import device_trace
     try:
         with device_trace(args.device_trace):
+            status_every = getattr(args, "status_every", 0.0)
             if args.fused:
                 app.run_fused_bsp(max_server_iterations=max_iters,
-                                  mesh=mesh)
+                                  mesh=mesh, status_every=status_every)
             elif args.mode == "serial":
                 app.run_serial(max_server_iterations=max_iters,
-                               pump=lambda: None)
+                               pump=lambda: None,
+                               status_every=status_every)
             else:
                 app.run_threaded(max_server_iterations=max_iters,
                                  failure_policy=args.failure_policy,
-                                 heartbeat_timeout=args.heartbeat_timeout)
+                                 heartbeat_timeout=args.heartbeat_timeout,
+                                 status_every=status_every)
     except KeyboardInterrupt:
         print("interrupted — shutting down", file=sys.stderr)
         app.stop()
     finally:
+        # teardown discipline (docs/TESTING.md): join every thread that
+        # can touch native code BEFORE interpreter finalization — the
+        # producer sinks rows into numpy slabs and the deferred-log
+        # drain threads dispatch device fetches
+        producer.stop()
         if args.checkpoint and process_index == 0:
             from kafka_ps_tpu.utils import checkpoint as ckpt
             ckpt.save(args.checkpoint, app.server,
                       buffers=app.server.checkpoint_buffers)
+        app.close_logs()
         for log in logs:
             log.close()
         if args.trace:

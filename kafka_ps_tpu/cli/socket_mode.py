@@ -198,6 +198,26 @@ def run_server(args) -> int:
                     print(f"readmitted worker {w} at clock {clock}",
                           file=sys.stderr, flush=True)
 
+    # live pulse (utils/status.py): iters/s, clocks, membership, queue
+    # depth — the split-mode face of `--status_every`
+    from kafka_ps_tpu.utils.status import StatusReporter
+
+    def status() -> dict:
+        tr = server.tracker
+        active = tr.active_workers
+        return {
+            "iters": server.iterations,
+            "clocks": [f"{w}:{tr.tracker[w].vector_clock}"
+                       for w in range(cfg.num_workers)],
+            "active": f"{len(active)}/{cfg.num_workers}",
+            "pending": {"gradients": fabric.total_pending(
+                fabric_mod.GRADIENTS_TOPIC)},
+            "rows_sent": producer.rows_sent,
+        }
+
+    reporter = StatusReporter(getattr(args, "status_every", 0.0) or 0.0,
+                              status).start()
+
     server.start_training_loop()
     max_iters = args.max_iterations or sys.maxsize
     try:
@@ -212,16 +232,20 @@ def run_server(args) -> int:
         # finally block still checkpoints and flushes logs/events
         print("interrupted — shutting down", file=sys.stderr, flush=True)
     finally:
-        bridge.close()       # workers see EOF and shut down
+        reporter.stop()
+        producer.stop()      # join the pump before teardown (SIGABRT
+                             # discipline: no native-code daemon threads
+                             # may outlive the main thread)
+        bridge.close()       # workers see EOF and shut down; joins
+                             # accept/heartbeat/reader threads
         if checkpoint_path:
             from kafka_ps_tpu.utils import checkpoint as ckpt
             ckpt.save(checkpoint_path, server)
         if reroute["dropped"] or bridge.dropped_sends:
             print(f"dropped rows: {reroute['dropped']}, dropped sends: "
                   f"{bridge.dropped_sends}", file=sys.stderr, flush=True)
-        server.log.flush()           # deferred eval lines out first
+        server.log.close()           # joins drain thread + closes sink
         events_log.close()
-        log.close()
     return 0
 
 
@@ -264,8 +288,25 @@ def run_worker(args) -> int:
                   f"(run {stored} != server run {bridge.server_run_id})",
                   file=sys.stderr, flush=True)
             os.remove(state_path)
-    log = CsvLogSink("./logs-worker.csv" if args.logging else None,
-                     WORKER_HEADER, append=restoring)
+    # Log continuity is decided by RUN continuity, not by whether buffer
+    # state restored (ADVICE r4): a worker SIGKILL'd before its first
+    # state snapshot has no state file, but its pre-crash log rows still
+    # belong to this logical run — truncating them would break the
+    # cross-restart audit trail.  A sidecar marker records which run the
+    # log belongs to.
+    log_path = "./logs-worker.csv" if args.logging else None
+    append_log = restoring
+    if log_path is not None:
+        marker = log_path + ".runid"
+        try:
+            with open(marker) as fh:
+                append_log = append_log or (
+                    int(fh.read().strip()) == bridge.server_run_id)
+        except (OSError, ValueError):
+            pass
+        with open(marker, "w") as fh:
+            fh.write(str(bridge.server_run_id))
+    log = CsvLogSink(log_path, WORKER_HEADER, append=append_log)
 
     buffers = {w: SlidingBuffer(cfg.model.num_features, cfg.buffer)
                for w in ids}
@@ -303,21 +344,28 @@ def run_worker(args) -> int:
             target=state_saver, daemon=True, name="kps-worker-state")
         state_saver_thread.start()
 
-    threading.Thread(target=bridge.run_reader, args=(buffers,),
-                     daemon=True, name="kps-worker-reader").start()
+    reader_thread = threading.Thread(target=bridge.run_reader,
+                                     args=(buffers,), daemon=True,
+                                     name="kps-worker-reader")
+    reader_thread.start()
 
     # READY per worker once its buffer has data (the server gates the
     # training-loop bootstrap on this, net.ServerBridge.wait_for_workers)
+    ready_stop = threading.Event()
+
     def announce_ready():
         pending = set(ids)
-        while pending and not bridge.disconnected.is_set():
+        while (pending and not bridge.disconnected.is_set()
+               and not ready_stop.is_set()):
             for w in list(pending):
                 if buffers[w].count > 0:
                     bridge.mark_ready(w)
                     pending.discard(w)
             time.sleep(0.01)
 
-    threading.Thread(target=announce_ready, daemon=True).start()
+    ready_thread = threading.Thread(target=announce_ready, daemon=True,
+                                    name="kps-worker-ready")
+    ready_thread.start()
 
     stop = threading.Event()
     errors: list[BaseException] = []
@@ -342,23 +390,51 @@ def run_worker(args) -> int:
         t.start()
     bridge.disconnected.wait()        # run until the server closes
     stop.set()
+    ready_stop.set()
+    # Shutdown discipline (the round-4 SIGABRT root cause, docs/
+    # TESTING.md): every thread that can touch JAX/XLA or numpy native
+    # code MUST be joined before the interpreter finalizes — a daemon
+    # thread killed inside C++ noexcept frames calls std::terminate.
+    # A worker loop is bounded (poll timeout 0.1 s + one local update),
+    # but the first post-load iteration can pay tens of seconds of jit
+    # compilation on a loaded machine, so the joins are generous.
+    leftover = []
     for t in threads:
-        t.join(timeout=5.0)
+        t.join(timeout=120.0)
+        if t.is_alive():
+            leftover.append(t.name)
     if state_path is not None:
         from kafka_ps_tpu.utils import checkpoint as ckpt
         state_stop.set()
         # join BEFORE the final save: two concurrent save_worker calls
         # share one tmp path and would corrupt the state file
-        state_saver_thread.join(timeout=10.0)
+        state_saver_thread.join(timeout=60.0)
         if state_saver_thread.is_alive():   # wedged in a stalled write
             print("warning: state saver still writing; skipping final "
                   "snapshot", file=sys.stderr, flush=True)
+            leftover.append(state_saver_thread.name)
         else:
             ckpt.save_worker(state_path, buffers,   # final snapshot
                              run_id=bridge.server_run_id)
-    worker_log.flush()               # deferred lines out before close
-    log.close()
+    worker_log.close()    # joins the drain thread, flushes, closes log
     bridge.close()
+    reader_thread.join(timeout=10.0)  # EOF/closed socket ends it
+    ready_thread.join(timeout=10.0)
+    for t in (reader_thread, ready_thread):
+        if t.is_alive():
+            leftover.append(t.name)
+    rc = 0
+    if errors:
+        print(f"worker failed: {errors[0]!r}", file=sys.stderr, flush=True)
+        rc = 1
+    if leftover:
+        # a thread survived its join and may be inside native code:
+        # skip interpreter finalization entirely rather than risk the
+        # teardown abort (this is a CLI process, nothing else to run)
+        print(f"warning: threads still alive at exit: {leftover}; "
+              "exiting without finalization", file=sys.stderr, flush=True)
+        sys.stdout.flush()
+        os._exit(rc)
     if errors:
         raise RuntimeError("worker failed") from errors[0]
     return 0

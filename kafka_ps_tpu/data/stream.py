@@ -93,9 +93,13 @@ class CsvStreamProducer:
         # memory, faster); False = force the lazy line-by-line Python
         # path (constant memory, first row immediately)
         self.use_native = use_native
-        self._sleep = sleep
+        # default pacing waits on the stop event, so stop() interrupts a
+        # sleep instantly; an injected sleep (tests) is called directly
+        self._sleep = sleep if sleep is not time.sleep else None
         self.rows_sent = 0
         self.finished = threading.Event()
+        self.stopped = threading.Event()
+        self._thread: threading.Thread | None = None
 
     def run(self) -> None:
         prefill = self.num_workers * self.prefill_per_worker
@@ -107,19 +111,35 @@ class CsvStreamProducer:
         for feats, label in iter_csv_rows(self.csv_path, self.has_header,
                                           self.num_features,
                                           use_native=self.use_native):
+            if self.stopped.is_set():
+                break
             worker = self.rows_sent % self.num_workers
             self.sink(worker, feats, label)
             self.rows_sent += 1
             if (rows_per_sleep and self.rows_sent >= prefill
                     and self.rows_sent % rows_per_sleep == 0):
-                self._sleep(1.0)
+                if self._sleep is not None:
+                    self._sleep(1.0)
+                elif self.stopped.wait(1.0):
+                    break
         self.finished.set()
 
     def run_in_background(self) -> threading.Thread:
         t = threading.Thread(target=self.run, daemon=True,
                              name="csv-stream-producer")
+        self._thread = t
         t.start()
         return t
+
+    def stop(self, join_timeout: float = 10.0) -> None:
+        """Stop the pump and JOIN its thread: the drive loops call this
+        on exit so the process never finalizes while the producer is
+        mid-sink (a daemon thread dying inside native numpy/XLA code
+        aborts the interpreter — the round-4 flake)."""
+        self.stopped.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=join_timeout)
 
 
 def load_csv_dataset(csv_path: str, has_header: bool = True

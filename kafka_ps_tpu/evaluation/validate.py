@@ -239,7 +239,13 @@ def _validate_elastic_epochs(worker_df: pd.DataFrame,
             rejoin = False
             if pending_readmit.get(w, 0) > 0:
                 pending_readmit[w] -= 1
-                readmit_times[w].pop(0)
+                # guarded (ADVICE r4): a resume clears early_claims but
+                # the early-claimed readmit event may still be ahead on
+                # the timeline; when it re-increments pending_readmit its
+                # timestamp was already popped, so the list can be empty
+                # here — report via the normal paths, don't crash
+                if readmit_times.get(w):
+                    readmit_times[w].pop(0)
                 rejoin = True
             elif (readmit_times.get(w)
                     # a truly broken +1 chain — `prev is None` is NOT a

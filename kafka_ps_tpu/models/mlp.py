@@ -12,7 +12,7 @@ Layout (flat, contiguous — the PS key space):
 
 Gradients come from `jax.grad`: safe here because every caller
 (parallel/bsp.py, parallel/range_sharded.py) marks theta device-varying
-with `pvary` before differentiating inside shard_map, so no replicated
+with `pcast(..., to="varying")` before differentiating inside shard_map, so no replicated
 cotangent psums are inserted (the hazard logreg.grad_loss documents).
 """
 

@@ -82,10 +82,10 @@ def make_bsp_step(cfg: ModelConfig, num_workers: int, server_lr: float,
             f"{mesh.devices.size}")
 
     def shard_body(theta, x, y, mask):
-        # x: [N/d, cap, F] on this device; theta replicated.  Mark theta
-        # device-varying so the scan carry inside local_update has a
+        # x: [N/d, cap, F] on this device; theta replicated.  Cast theta
+        # to device-varying so the scan carry inside local_update has a
         # stable varying-axes type (psum below restores invariance).
-        theta_v = jax.lax.pvary(theta, WORKER_AXIS)
+        theta_v = jax.lax.pcast(theta, WORKER_AXIS, to="varying")
         deltas, losses = _vmapped_local_updates(theta_v, x, y, mask, task)
         delta_sum = jax.lax.psum(deltas.sum(0), WORKER_AXIS)
         loss_sum = jax.lax.psum(losses.sum(), WORKER_AXIS)
@@ -111,9 +111,11 @@ def make_bsp_multi_step(cfg: ModelConfig, num_workers: int, server_lr: float,
     task = task or _default_task(cfg)
 
     def round_body(theta, x, onehot, mask, psum_axis: bool):
-        # The scan carry stays axis-invariant: pvary a per-round copy for
-        # the device-local math, psum the delta back to invariance.
-        theta_local = jax.lax.pvary(theta, WORKER_AXIS) if psum_axis else theta
+        # The scan carry stays axis-invariant: pcast a per-round copy to
+        # device-varying for the local math, psum the delta back to
+        # invariance.
+        theta_local = (jax.lax.pcast(theta, WORKER_AXIS, to="varying")
+                       if psum_axis else theta)
         deltas, losses = _vmapped_local_updates_onehot(
             theta_local, x, onehot, mask, task)
         delta_sum, loss_sum = deltas.sum(0), losses.sum()

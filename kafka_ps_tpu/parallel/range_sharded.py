@@ -104,7 +104,7 @@ def make_range_sharded_step(cfg: ModelConfig, num_workers: int,
         # weights pull: reassemble the full replica from the server shards
         theta_full = jax.lax.all_gather(theta_shard, PARAM_AXIS, axis=0,
                                         tiled=True)
-        theta_full = jax.lax.pvary(theta_full, WORKER_AXIS)
+        theta_full = jax.lax.pcast(theta_full, WORKER_AXIS, to="varying")
         deltas, losses = jax.vmap(
             lambda xx, yy, mm: local_update_padded(theta_full, xx, yy, mm)
         )(x, y, mask)

@@ -125,6 +125,30 @@ class StreamingPSApp:
         self.workers[worker_id].last_progress = time.monotonic()
         return clock
 
+    # -- live observability (utils/status.py) ------------------------------
+
+    def status(self) -> dict:
+        """One sample of the runtime's pulse — rendered by StatusReporter
+        as the periodic `[status]` stderr line (`--status_every`)."""
+        tr = self.server.tracker
+        active = tr.active_workers
+        return {
+            "iters": self.server.iterations,
+            "clocks": [f"{w}:{tr.tracker[w].vector_clock}"
+                       for w in range(self.cfg.num_workers)],
+            "active": f"{len(active)}/{self.cfg.num_workers}",
+            "pending": {
+                "weights": self.fabric.total_pending(
+                    fabric_mod.WEIGHTS_TOPIC),
+                "gradients": self.fabric.total_pending(
+                    fabric_mod.GRADIENTS_TOPIC)},
+            "buffers": [b.count for b in self.buffers],
+        }
+
+    def _start_status(self, status_every: float | None):
+        from kafka_ps_tpu.utils.status import StatusReporter
+        return StatusReporter(status_every or 0.0, self.status).start()
+
     # -- drive loops -------------------------------------------------------
 
     def flush_logs(self) -> None:
@@ -136,12 +160,24 @@ class StreamingPSApp:
             if flush is not None:
                 flush()
 
+    def close_logs(self) -> None:
+        """Close the deferred sinks: joins their drain threads (which
+        dispatch device fetches) and closes the wrapped file sinks.  The
+        CLI calls this at exit so the process never finalizes with a
+        live thread inside XLA (docs/TESTING.md)."""
+        for sink in (self.server.log, *{id(w.log): w.log
+                                        for w in self.workers}.values()):
+            close = getattr(sink, "close", None)
+            if close is not None:
+                close()
+
     def run_serial(self, max_server_iterations: int,
-                   pump=None) -> None:
+                   pump=None, status_every: float | None = None) -> None:
         """Deterministic scheduler: alternate weights delivery / gradient
         processing until the server has applied `max_server_iterations`
         gradient messages.  `pump()` (optional) feeds more stream rows
         between rounds."""
+        reporter = self._start_status(status_every)
         self.server.start_training_loop()
         stalled_rounds = 0
         try:
@@ -168,12 +204,14 @@ class StreamingPSApp:
                 if stalled_rounds > (1000 if pump is not None else 0):
                     raise RuntimeError("deadlock: no deliverable messages")
         finally:
+            reporter.stop()
             self.flush_logs()
 
     def run_threaded(self, max_server_iterations: int,
                      poll_timeout: float = 0.1,
                      failure_policy: str = "halt",
-                     heartbeat_timeout: float | None = None) -> None:
+                     heartbeat_timeout: float | None = None,
+                     status_every: float | None = None) -> None:
         """One thread per worker (the reference's stream threads); server
         on the calling thread, doubling as the supervisor.
 
@@ -268,6 +306,7 @@ class StreamingPSApp:
                 if hung:
                     evict(w, f"no heartbeat for {heartbeat_timeout}s")
 
+        reporter = self._start_status(status_every)
         try:
             self.server.start_training_loop()
             while self.server.iterations < max_server_iterations:
@@ -280,15 +319,20 @@ class StreamingPSApp:
                 if failure_policy == "rebalance":
                     supervise()
         finally:
+            reporter.stop()
             self._stop.set()
+            # generous: an in-flight on_weights may be paying first-call
+            # jit compilation on a loaded machine (the 5 s join of
+            # rounds 2-4 could expire and leave the thread running)
             for t in threads.values():
-                t.join(timeout=5.0)
+                t.join(timeout=60.0)
             self.flush_logs()
         if worker_errors:
             raise RuntimeError("worker thread failed") from worker_errors[0]
 
     def run_fused_bsp(self, max_server_iterations: int, mesh=None,
-                      log_metrics: bool = True) -> None:
+                      log_metrics: bool = True,
+                      status_every: float | None = None) -> None:
         """Sequential consistency as fused shard_map steps.  Each step is
         one full BSP iteration (all workers advance one clock).
 
@@ -358,6 +402,22 @@ class StreamingPSApp:
         # the bottleneck.  num_tuples_seen strictly increases on every
         # insert, so it is the buffer content version.
         slab_versions: list[int] | None = None
+        x = y = mask = None
+        reporter = self._start_status(status_every)
+        try:
+            self._run_fused_loop(max_server_iterations, mesh, log_metrics,
+                                 range_mode, multiproc, step, theta, clock,
+                                 active, feed, slab_versions, task)
+        finally:
+            reporter.stop()
+
+    def _run_fused_loop(self, max_server_iterations, mesh, log_metrics,
+                        range_mode, multiproc, step, theta, clock, active,
+                        feed, slab_versions, task) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from kafka_ps_tpu.parallel import range_sharded
         x = y = mask = None
         while self.server.iterations < max_server_iterations:
             versions = [self.buffers[w].num_tuples_seen for w in feed]

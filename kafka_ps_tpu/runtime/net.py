@@ -143,11 +143,16 @@ class ServerBridge:
         self.dropped_sends = 0      # frames lost to dead connections
         self._hb_interval = heartbeat_interval
         self._hb_timeout = heartbeat_timeout
-        threading.Thread(target=self._accept_loop, daemon=True,
-                         name="kps-net-accept").start()
+        self._reader_threads: list[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="kps-net-accept")
+        self._accept_thread.start()
+        self._hb_thread = None
         if heartbeat_interval:
-            threading.Thread(target=self._heartbeat_loop, daemon=True,
-                             name="kps-net-heartbeat").start()
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name="kps-net-heartbeat")
+            self._hb_thread.start()
 
     # -- fabric integration ------------------------------------------------
 
@@ -211,11 +216,22 @@ class ServerBridge:
             self._listener.close()
         except OSError:
             pass
-        for conn in list(self._conn_of.values()):
-            try:
-                conn.close()
-            except OSError:
-                pass
+        # join the accept loop FIRST: it may have accepted a connection
+        # just before the listener closed, and no reader must be
+        # spawned after the sweep below (a missed one would survive its
+        # join and die inside native recv at interpreter exit)
+        if self._accept_thread is not threading.current_thread():
+            self._accept_thread.join(timeout=10.0)
+        # every live connection, including ones that never sent HELLO
+        for conn in list(self._send_lock):
+            force_close(conn)        # wakes the blocked reader thread
+        # join everything before returning: readers hand GRADIENTS into
+        # the fabric (device arrays) and the heartbeat waits at most one
+        # interval — a thread left alive at interpreter exit can die
+        # inside native code and abort the process
+        for t in (*self._reader_threads, self._hb_thread):
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=10.0)
 
     # -- internals ---------------------------------------------------------
 
@@ -250,11 +266,21 @@ class ServerBridge:
                 conn, _addr = self._listener.accept()
             except OSError:
                 return
+            if self._stop.is_set():
+                # raced close(): the listener accepted this connection
+                # before it was torn down — it must not outlive close()
+                force_close(conn)
+                return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._send_lock[conn] = threading.Lock()
             self._last_recv[conn] = time.monotonic()
-            threading.Thread(target=self._reader, args=(conn,),
-                             daemon=True, name="kps-net-reader").start()
+            t = threading.Thread(target=self._reader, args=(conn,),
+                                 daemon=True, name="kps-net-reader")
+            t.start()
+            # prune finished readers so worker churn over a long
+            # rebalance run doesn't accumulate dead Thread objects
+            self._reader_threads = [r for r in self._reader_threads
+                                    if r.is_alive()] + [t]
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self._hb_interval):

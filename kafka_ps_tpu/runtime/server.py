@@ -66,6 +66,14 @@ class ServerNode:
         import jax
         self._apply_full = jax.jit(
             lambda t, d: t + self.cfg.server_lr * d)
+
+        # apply + eval as ONE dispatch (per-dispatch host latency bounds
+        # the per-node path over a tunneled transport, VERDICT r4 #2)
+        def _apply_eval(t, d, tx, ty):
+            t2 = t + self.cfg.server_lr * d
+            m = self.task.evaluate(t2, tx, ty)
+            return t2, m
+        self._apply_full_eval = jax.jit(_apply_eval)
         self.test_x = jnp.asarray(test_x) if test_x is not None else None
         self.test_y = jnp.asarray(test_y) if test_y is not None else None
         self.log = log or (lambda line: None)
@@ -222,13 +230,26 @@ class ServerNode:
         self.tracker.received_message(msg.worker_id, msg.vector_clock)
         self.tracer.count("server.gradients_applied")
 
+        want_eval = (msg.worker_id == 0 and self.test_x is not None
+                     and msg.vector_clock % self.cfg.eval_every == 0)
+        m = None
         with self.tracer.span("server.apply", worker=msg.worker_id,
                               clock=msg.vector_clock):
             r = msg.key_range
             if r.start == 0 and r.end == self.task.num_params:
-                # per-node protocol: one async jit'd add, no host sync
-                self.theta = self._apply_full(jnp.asarray(self.theta),
-                                              msg.values)
+                # per-node protocol: one async jit'd dispatch, no host
+                # sync — eval iterations fuse the evaluation in (the
+                # nested span keeps server.eval visible to --trace
+                # consumers even though the dispatch is shared)
+                if want_eval:
+                    with self.tracer.span("server.eval",
+                                          clock=msg.vector_clock):
+                        self.theta, m = self._apply_full_eval(
+                            jnp.asarray(self.theta), msg.values,
+                            self.test_x, self.test_y)
+                else:
+                    self.theta = self._apply_full(jnp.asarray(self.theta),
+                                                  msg.values)
             else:
                 host = np.array(self.theta)
                 host[r.start:r.end] += (self.cfg.server_lr
@@ -236,11 +257,11 @@ class ServerNode:
                 self.theta = host
             self.iterations += 1
 
-        if (msg.worker_id == 0 and self.test_x is not None
-                and msg.vector_clock % self.cfg.eval_every == 0):
-            with self.tracer.span("server.eval", clock=msg.vector_clock):
-                m = self.task.evaluate(jnp.asarray(self.theta), self.test_x,
-                                       self.test_y)
+        if want_eval:
+            if m is None:            # partial-range splice path
+                with self.tracer.span("server.eval", clock=msg.vector_clock):
+                    m = self.task.evaluate(jnp.asarray(self.theta),
+                                           self.test_x, self.test_y)
             self.last_metrics = m            # device futures; float() syncs
             # schema: timestamp;partition;vectorClock;loss;fMeasure;accuracy
             # (ServerAppRunner.java:81); partition=-1 like the reference,

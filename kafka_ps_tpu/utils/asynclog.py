@@ -79,11 +79,13 @@ class DeferredSink:
     """
 
     def __init__(self, sink, max_pending: int = 4096,
-                 drain_interval: float = 0.25):
+                 drain_interval: float = 0.25,
+                 idle_exit: float = 10.0):
         self._sink = sink
         self._pending: deque = deque()
         self._max_pending = max_pending
         self._interval = drain_interval
+        self._idle_exit = idle_exit
         self._lock = threading.Lock()        # guards _pending
         self._emit_lock = threading.Lock()   # serializes pop+emit
         self._wake = threading.Event()
@@ -111,12 +113,22 @@ class DeferredSink:
     # -- drain side --------------------------------------------------------
 
     def _ensure_thread(self) -> None:
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._drain_loop, daemon=True, name="kps-log-drain")
-            self._thread.start()
+        with self._lock:
+            t = self._thread
+            if t is None or not t.is_alive():
+                self._thread = threading.Thread(
+                    target=self._drain_loop, daemon=True,
+                    name="kps-log-drain")
+                self._thread.start()
 
     def _drain_loop(self) -> None:
+        # Exits after _idle_exit seconds with nothing pending (restarted
+        # by the next submit): a long-lived process (or a test suite
+        # creating many sinks) must not accumulate forever-waking
+        # threads — and a daemon thread that keeps dispatching device
+        # fetches at interpreter exit dies inside XLA's C++ and aborts
+        # the process (the round-4 SIGABRT, docs/TESTING.md).
+        idle = 0.0
         while not self._stop.is_set():
             self._wake.wait(timeout=self._interval)
             self._wake.clear()
@@ -124,6 +136,15 @@ class DeferredSink:
                 self._drain_ready()
             except Exception as e:   # pragma: no cover - diagnostics
                 print(f"log drain error: {e!r}", file=sys.stderr)
+            with self._lock:
+                if self._pending:
+                    idle = 0.0
+                    continue
+                idle += self._interval
+                if idle >= self._idle_exit:
+                    if self._thread is threading.current_thread():
+                        self._thread = None
+                    return
 
     def _drain_ready(self) -> None:
         with self._emit_lock:
@@ -145,13 +166,29 @@ class DeferredSink:
                     if _is_jax(v)]
         fetched: dict[int, float] = {}
         if jax_vals:
-            flat = _fetch_batched(jax_vals)
-            fetched = {id(v): flat[i] for i, v in enumerate(jax_vals)}
+            try:
+                flat = _fetch_batched(jax_vals)
+                fetched = {id(v): flat[i] for i, v in enumerate(jax_vals)}
+            except Exception as e:   # deleted/donated buffer poisoned
+                # the batch: fall back to per-value fetch below so the
+                # OTHER lines still come out (a nan marks the bad value
+                # instead of silently dropping audit-relevant CSV rows)
+                print(f"batched log fetch failed ({e!r}); falling back "
+                      "to per-value fetch", file=sys.stderr)
+
+        def resolve(v) -> float:
+            if not _is_jax(v):
+                return float(v)
+            if id(v) in fetched:
+                return fetched[id(v)]
+            try:
+                return float(v)
+            except Exception:
+                return float("nan")
+
         for template, values in entries:
             if values:
-                template = template.format(
-                    *(fetched[id(v)] if _is_jax(v) else float(v)
-                      for v in values))
+                template = template.format(*(resolve(v) for v in values))
             self._sink(template)
 
     def flush_ready(self) -> None:
@@ -168,8 +205,12 @@ class DeferredSink:
     def close(self) -> None:
         self._stop.set()
         self._wake.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            # the drain thread may be mid device-fetch; a process must
+            # never finalize while it is inside XLA (SIGABRT) — wait it
+            # out (its work is bounded: one batched fetch)
+            t.join(timeout=60.0)
         self.flush()
         close = getattr(self._sink, "close", None)
         if close is not None:

@@ -60,5 +60,8 @@ class CsvLogSink:
             self._fh.flush()
 
     def close(self) -> None:
+        # idempotent: a CsvLogSink wrapped in a DeferredSink is closed
+        # by the wrapper AND by the CLI's own cleanup
         if self._close:
+            self._close = False
             self._fh.close()

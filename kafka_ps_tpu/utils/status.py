@@ -1,0 +1,84 @@
+"""Periodic runtime status line — the live-observability stand-in for
+the reference's Confluent Control Center (dev/docker-compose.yaml:30-47
+runs a full web UI streaming per-topic message flow).
+
+A broker UI makes no sense without a broker; the deliberate divergence
+(docs/EVALUATION.md) is a one-line status heartbeat on stderr, emitted
+by the drive loops every `--status_every` seconds:
+
+    [status] iters=412 (+38.0/s) clocks=0:103,1:103,2:102,3:103 \
+        active=4/4 pending weights=2 gradients=1 buffers=256,256,256,256
+
+Post-hoc deep inspection stays with the tracer (`--trace` Chrome trace,
+utils/trace.py — the interceptor analogue); this is the live pulse: is
+it making progress, how fast, who is lagging, is a queue backing up.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Callable
+
+
+class StatusReporter:
+    """Prints `source()` every `interval` seconds on its own thread.
+
+    `source` returns a dict; an `iters` key gets a derived rate
+    (+N/s since the previous line).  The thread only formats and
+    prints host-side state — still joined on stop(), per the teardown
+    discipline (docs/TESTING.md)."""
+
+    def __init__(self, interval: float, source: Callable[[], dict],
+                 out=None, clock=time.monotonic):
+        self.interval = interval
+        self.source = source
+        self.out = out if out is not None else sys.stderr
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_iters: int | None = None
+        self._last_ts: float | None = None
+
+    def start(self) -> "StatusReporter":
+        if self.interval and self.interval > 0 and self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="kps-status")
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.emit()
+
+    def emit(self) -> None:
+        """One status line now (also called directly by tests)."""
+        try:
+            fields = self.source()
+        except Exception as e:       # a torn-down source must not kill
+            fields = {"error": repr(e)}
+        now = self._clock()
+        parts = []
+        for k, v in fields.items():
+            if k == "iters" and isinstance(v, (int, float)):
+                rate = ""
+                if self._last_iters is not None and now > self._last_ts:
+                    per_s = (v - self._last_iters) / (now - self._last_ts)
+                    rate = f" (+{per_s:.1f}/s)"
+                self._last_iters, self._last_ts = v, now
+                parts.append(f"iters={v}{rate}")
+            elif isinstance(v, dict):
+                inner = " ".join(f"{ik}={iv}" for ik, iv in v.items())
+                parts.append(f"{k} {inner}")
+            elif isinstance(v, (list, tuple)):
+                parts.append(f"{k}=" + ",".join(str(i) for i in v))
+            else:
+                parts.append(f"{k}={v}")
+        print("[status] " + " ".join(parts), file=self.out, flush=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10.0)

@@ -294,3 +294,56 @@ def test_cli_param_shards_requires_fused():
         ["--param_shards", "2", "-test", "nonexistent.csv"])
     with __import__("pytest").raises(SystemExit, match="requires --fused"):
         run_mod.run_with_args(args)
+
+
+def test_status_reporter_formats_and_rates():
+    """utils/status.py: field rendering + the derived iters/s rate —
+    the Control Center stand-in's line format (docs/EVALUATION.md)."""
+    import io
+
+    from kafka_ps_tpu.utils.status import StatusReporter
+
+    samples = iter([{"iters": 0, "clocks": ["0:1", "1:1"],
+                     "active": "2/2", "pending": {"gradients": 3}},
+                    {"iters": 20, "clocks": ["0:6", "1:5"],
+                     "active": "2/2", "pending": {"gradients": 0}}])
+    ticks = iter([0.0, 2.0])
+    out = io.StringIO()
+    rep = StatusReporter(0.0, lambda: next(samples), out=out,
+                         clock=lambda: next(ticks))
+    rep.emit()
+    rep.emit()
+    lines = out.getvalue().splitlines()
+    assert lines[0].startswith("[status] iters=0 clocks=0:1,1:1")
+    assert "pending gradients=3" in lines[0]
+    # 20 iters over 2 s -> +10.0/s on the second line
+    assert "iters=20 (+10.0/s)" in lines[1]
+    assert "active=2/2" in lines[1]
+
+
+def test_status_reporter_survives_source_errors():
+    import io
+
+    from kafka_ps_tpu.utils.status import StatusReporter
+
+    out = io.StringIO()
+
+    def bad_source():
+        raise RuntimeError("torn down")
+
+    rep = StatusReporter(0.0, bad_source, out=out)
+    rep.emit()                       # must not raise
+    assert "error=" in out.getvalue()
+
+
+def test_threaded_run_emits_status_lines(capsys):
+    """`--status_every` through the drive loop: the reporter thread
+    samples a live run and stops cleanly with it."""
+    app, logs, _ = build_app(0)
+    app.run_threaded(max_server_iterations=40, status_every=0.05)
+    err = capsys.readouterr().err
+    status_lines = [l for l in err.splitlines()
+                    if l.startswith("[status]")]
+    assert status_lines, err
+    assert "clocks=" in status_lines[-1]
+    assert "buffers=" in status_lines[-1]
