@@ -16,7 +16,9 @@ import argparse
 from kafka_ps_tpu.cli import run as run_mod
 
 
-def main(argv=None) -> int:
+def build_parser() -> argparse.ArgumentParser:
+    """The server-role flag surface (also validated against the
+    deployment manifests in tests/test_deploy.py)."""
     parser = run_mod.build_parser(include_server_flags=True,
                                   include_worker_flags=False,
                                   prog="ServerAppRunner")
@@ -29,7 +31,11 @@ def main(argv=None) -> int:
              "separate-server-JVM topology (run.sh:15-18)")
     parser.add_argument("--connect_timeout", type=float, default=60.0,
                         help="--listen: seconds to wait for all workers")
-    args = parser.parse_args(argv)
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
     # worker-side defaults (WorkerAppRunner.java:55-58)
     args = argparse.Namespace(min_buffer_size=128, max_buffer_size=1024,
                               buffer_size_coefficient=0.3, **vars(args))

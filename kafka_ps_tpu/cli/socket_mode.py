@@ -328,12 +328,15 @@ def run_worker(args) -> int:
         from kafka_ps_tpu.utils import checkpoint as ckpt
         state_stop = threading.Event()
 
+        state_every = getattr(args, "state_every", 1.0) or 1.0
+
         def state_saver():
-            # the changelog analogue: snapshot on a cadence so a
-            # SIGKILL'd process loses at most one interval of rows;
-            # skip idle intervals (no new insertions = same slab)
+            # the changelog analogue: snapshot on a cadence (the
+            # --state_every flag) so a SIGKILL'd process loses at most
+            # one interval of rows; skip idle intervals (no new
+            # insertions = same slab)
             last = None
-            while not state_stop.wait(1.0):
+            while not state_stop.wait(state_every):
                 fp = tuple(buffers[w].num_tuples_seen for w in ids)
                 if fp != last:
                     ckpt.save_worker(state_path, buffers,

@@ -14,7 +14,9 @@ import argparse
 from kafka_ps_tpu.cli import run as run_mod
 
 
-def main(argv=None) -> int:
+def build_parser() -> argparse.ArgumentParser:
+    """The worker-role flag surface (also validated against the
+    deployment manifests in tests/test_deploy.py)."""
     parser = run_mod.build_parser(include_server_flags=False,
                                   include_worker_flags=True,
                                   prog="WorkerAppRunner")
@@ -27,7 +29,18 @@ def main(argv=None) -> int:
     parser.add_argument("--worker_ids", default="0",
                         help="--connect: comma-separated logical worker "
                              "ids this process hosts")
-    args = parser.parse_args(argv)
+    parser.add_argument("--state_every", type=float, default=1.0,
+                        metavar="SECONDS",
+                        help="--connect + --checkpoint: cadence of the "
+                             "durable buffer-state snapshots (the "
+                             "changelog analogue, WorkerApp.java:40-42) "
+                             "— a SIGKILL'd process loses at most one "
+                             "interval of rows")
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
     # server-side defaults (ServerAppRunner.java:59-63, BaseKafkaApp.java:35)
     args = argparse.Namespace(training_data_file_path="./data/train.csv",
                               consistency_model=0,

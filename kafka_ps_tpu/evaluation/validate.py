@@ -75,9 +75,17 @@ def validate_worker_log(worker_df: pd.DataFrame,
     monotonicity is checked — membership changes void the static bound
     and nothing records where they happened."""
     out: list[Violation] = []
-    if elastic and membership_events is not None:
+    if membership_events or (elastic and membership_events is not None):
+        # membership events existing IS the elastic signal: a run whose
+        # record carries evict/readmit/resume must be audited
+        # epoch-aware, whatever the caller passed for `elastic` — the
+        # static +1/spread contract is provably void across any of
+        # those events (a resume rewinds clocks to the last periodic
+        # checkpoint; an eviction freezes one).  `elastic` only matters
+        # when the caller supplies NO events: True relaxes the static
+        # +1 check to monotonicity (legacy eventless elastic runs).
         return _validate_elastic_epochs(worker_df, consistency_model,
-                                        membership_events)
+                                        membership_events or [])
     # 1. per-worker clocks
     for w, g in worker_df.groupby("partition"):
         clocks = g["vectorClock"].tolist()
@@ -171,14 +179,43 @@ def _validate_elastic_epochs(worker_df: pd.DataFrame,
     pending_readmit: dict[int, int] = {}
     early_claims: dict[int, int] = {}
 
+    # Crash-truncation exemption: split-mode workers log through a
+    # deferred sink (utils/asynclog.py), so a SIGKILL'd process loses
+    # its final pending rows — its LOGGED clock then understates its
+    # true protocol clock by however far it ran before dying, and the
+    # apparent spread inflates without any real staleness.  In an epoch
+    # that ends in a crash (marked by the following "resume" event), a
+    # worker whose log has gone silent for the REST of that epoch
+    # therefore stops constraining the spread from its last row onward
+    # ("stalled" and "rows lost to the crash" are indistinguishable
+    # from the log; bias to no false positives, like the rest of this
+    # auditor).  Healthy epochs — no resume ahead — are unaffected.
+    resume_ts = sorted(int(ts_) for ts_, kind_, _ in events_sorted
+                       if kind_ == "resume")
+    last_row_ts: dict[tuple[int, int], int] = {}
+    for _, row in rows.iterrows():
+        rts = int(row["timestamp"])
+        epoch = sum(1 for r in resume_ts if r <= rts)
+        key = (int(row["partition"]), epoch)
+        last_row_ts[key] = max(last_row_ts.get(key, rts), rts)
+
+    def spread_workers(ts: int) -> dict[int, int]:
+        nxt = next((r for r in resume_ts if r > ts), None)
+        if nxt is None:
+            return latest
+        epoch = sum(1 for r in resume_ts if r <= ts)
+        return {w: c for w, c in latest.items()
+                if last_row_ts.get((w, epoch), -1) >= ts}
+
     def spread_check(ts: int) -> None:
-        if check_bound and len(latest) > 1:
-            spread = max(latest.values()) - min(latest.values())
+        clocks = spread_workers(ts)
+        if check_bound and len(clocks) > 1:
+            spread = max(clocks.values()) - min(clocks.values())
             if spread > bound:
                 out.append(Violation(
                     "staleness-bound",
                     f"spread {spread} > bound {bound} at timestamp "
-                    f"{ts} (clocks {dict(sorted(latest.items()))})"))
+                    f"{ts} (clocks {dict(sorted(clocks.items()))})"))
 
     # workers whose NEXT row follows a checkpoint resume: the crash
     # killed the in-flight messages and the restored server re-sends

@@ -24,14 +24,18 @@ models/logreg — at the reference's shapes (B≤1024, F=1024, C=5) the
 whole problem fits on-chip.
 
 Measured A/B (bench.py, interleaved pipelined dispatch, TPU v5e,
-B=1024 F=1024 k=2, BENCH_r03): 972.1 pallas vs 981.3 XLA
-local-updates/s — **0.99x, i.e. parity** (BENCH_r02 recorded the same:
-817.8 vs 812.5, 1.006x).  SURVEY §7 predicted this: at 6150 parameters
-XLA already fuses the whole k-step loop well, so the kernel earns its
-keep only as the explicit-VMEM-residency form of the op (single
-pallas_call holding the solver loop on-chip) for shapes near the VMEM
-boundary, not as a speedup at reference scale.  The default path stays
-XLA (`--pallas` opts in).
+B=1024 F=1024 k=2; per-trial medians with IQR since r05): BENCH_r05
+records pallas 1062.4 (IQR 385.6) vs XLA 782.9 (IQR 449.4)
+local-updates/s over 5 interleaved trials — **1.36x median speedup**,
+but with overlapping spreads on this tunneled chip.  History: r02
+1.006x, r03 0.99x, r04 1.31x — the truthful statement is "between
+parity and ~1.4x, dominated by transport variance", which is why the
+JSON now carries {median, iqr, trials} per arm.  SURVEY §7 predicted
+roughly this: at 6150 parameters XLA already fuses the k-step loop
+well; the kernel's durable value is the explicit-VMEM-residency form
+of the op (single pallas_call holding the solver loop on-chip) for
+shapes near the VMEM boundary.  The default path stays XLA
+(`--pallas` opts in).
 """
 
 from __future__ import annotations

@@ -31,6 +31,7 @@ from kafka_ps_tpu.parallel import bsp
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime.server import LogSink, ServerNode
 from kafka_ps_tpu.runtime.worker import WorkerNode
+from kafka_ps_tpu.utils import asynclog
 from kafka_ps_tpu.utils.asynclog import DeferredSink
 from kafka_ps_tpu.utils.config import PSConfig, SEQUENTIAL
 from kafka_ps_tpu.utils.trace import NULL_TRACER
@@ -65,6 +66,12 @@ class StreamingPSApp:
                        worker_log, tracer=self.tracer)
             for w in range(cfg.num_workers)]
         self._stop = threading.Event()
+        # fused-program cache: re-entering run_fused_bsp (resume, bench
+        # trials, alternating with other drive modes) must reuse the
+        # SAME jit wrappers — a fresh jax.jit(shard_map(...)) re-traces
+        # the whole multi-round program every call (hundreds of ms at
+        # MLP-4096) even when the XLA compile cache hits
+        self._fused_programs: dict = {}
         self._reroute_counter = 0
         self.worker_failures: list[tuple[int, BaseException | str]] = []
         # Multi-host: the subset of logical workers this process hosts
@@ -178,9 +185,9 @@ class StreamingPSApp:
         gradient messages.  `pump()` (optional) feeds more stream rows
         between rounds."""
         reporter = self._start_status(status_every)
-        self.server.start_training_loop()
         stalled_rounds = 0
         try:
+            self.server.start_training_loop()
             while self.server.iterations < max_server_iterations:
                 progressed = False
                 for worker in self.workers:
@@ -360,17 +367,22 @@ class StreamingPSApp:
         # the data reroute and their tracker slots must stay frozen)
         active = self.server.tracker.active_workers
         task = self.server.task
+        progs = self._fused_programs.setdefault(
+            ("range" if range_mode else "bsp", len(active), mesh), {})
         if range_mode:
-            step = range_sharded.make_range_sharded_step(
-                self.cfg.model, len(active), self.cfg.server_lr, mesh,
-                task=task)
+            if "step" not in progs:
+                progs["step"] = range_sharded.make_range_sharded_step(
+                    self.cfg.model, len(active), self.cfg.server_lr, mesh,
+                    task=task)
             theta = range_sharded.shard_theta(
                 mesh, jnp.asarray(self.server.theta), task)
         else:
-            step = bsp.make_bsp_step(self.cfg.model, len(active),
-                                     self.cfg.server_lr, mesh=mesh,
-                                     task=task)
+            if "step" not in progs:
+                progs["step"] = bsp.make_bsp_step(
+                    self.cfg.model, len(active), self.cfg.server_lr,
+                    mesh=mesh, task=task)
             theta = jnp.asarray(self.server.theta)
+        step = progs["step"]
         # under BSP all active clocks are uniform; resume from the
         # restored one
         clock = min(self.server.tracker.clocks[w] for w in active)
@@ -401,24 +413,47 @@ class StreamingPSApp:
         # unchanged slabs per iteration would make host->device transfer
         # the bottleneck.  num_tuples_seen strictly increases on every
         # insert, so it is the buffer content version.
-        slab_versions: list[int] | None = None
-        x = y = mask = None
         reporter = self._start_status(status_every)
         try:
             self._run_fused_loop(max_server_iterations, mesh, log_metrics,
                                  range_mode, multiproc, step, theta, clock,
-                                 active, feed, slab_versions, task)
+                                 active, feed, task, progs)
         finally:
             reporter.stop()
 
+    # rounds per fused chunk dispatch: big enough to amortize the
+    # per-dispatch host latency (~tens of ms over a tunneled transport),
+    # small enough that stream arrivals are picked up promptly
+    FUSED_CHUNK_ROUNDS = 8
+
     def _run_fused_loop(self, max_server_iterations, mesh, log_metrics,
                         range_mode, multiproc, step, theta, clock, active,
-                        feed, slab_versions, task) -> None:
+                        feed, task, progs) -> None:
         import jax
         import jax.numpy as jnp
 
         from kafka_ps_tpu.parallel import range_sharded
+
+        # Chunking: stretches with no eval boundary run CHUNK rounds as
+        # ONE lax.scan dispatch (bsp.make_bsp_multi_step) — without it
+        # the runtime pays a full dispatch round-trip per round and
+        # falls to ~1/4 of the kernel rate at MLP-4096 (BENCH r5; the
+        # "framework adds no overhead that survives scale" claim,
+        # docs/ROOFLINE.md).  Eval cadences land exactly: a chunk never
+        # crosses an eval clock, and eval_every=1 degenerates to the
+        # per-round path.  Range-sharded mode has no multi-step program
+        # (parallel/range_sharded.py) and always steps singly.
+        CHUNK = self.FUSED_CHUNK_ROUNDS
+
+        def get_multi_step():
+            if "multi_step" not in progs:
+                progs["multi_step"] = bsp.make_bsp_multi_step(
+                    self.cfg.model, len(active), self.cfg.server_lr,
+                    CHUNK, mesh=mesh, task=task)
+            return progs["multi_step"]
+
         x = y = mask = None
+        slab_versions: list[int] | None = None
         while self.server.iterations < max_server_iterations:
             versions = [self.buffers[w].num_tuples_seen for w in feed]
             # The version cache stays valid multi-process: the global
@@ -451,15 +486,30 @@ class StreamingPSApp:
                     x, y, mask = (jnp.asarray(x), jnp.asarray(y),
                                   jnp.asarray(mask))
                 slab_versions = versions
-            with self.tracer.span("bsp.step", clock=clock + 1):
-                theta, mean_loss = step(theta, x, y, mask)
+            # rounds until the run cap / the next eval clock
+            rounds_left = -((self.server.iterations - max_server_iterations)
+                            // len(active))
+            r = min(CHUNK, rounds_left)
+            if log_metrics and self.server.test_x is not None:
+                r = min(r, self.cfg.eval_every
+                        - (clock % self.cfg.eval_every))
+            use_chunk = r == CHUNK and not range_mode
+            if not use_chunk:
+                r = 1
+            losses = None
+            with self.tracer.span("bsp.step", clock=clock + 1, rounds=r):
+                if use_chunk:
+                    theta, losses = get_multi_step()(theta, x, y, mask)
+                    mean_loss = losses[-1]
+                else:
+                    theta, mean_loss = step(theta, x, y, mask)
                 if self.tracer.enabled:
                     # sync so the span measures the real step, not the
                     # async dispatch; untraced runs keep pipelining
                     mean_loss = float(mean_loss)
             self.tracer.count("bsp.steps")
-            clock += 1
-            self.server.iterations += len(active)
+            clock += r
+            self.server.iterations += r * len(active)
             # theta is updated by replacement everywhere (runtime/server
             # module doc), so the device array is stored directly — no
             # per-step device->host copy
@@ -468,38 +518,56 @@ class StreamingPSApp:
             else:
                 self.server.theta = theta
             for w in active:
-                self.workers[w].iterations += 1
+                self.workers[w].iterations += r
                 self.server.tracker.tracker[w].vector_clock = clock
                 self.server.tracker.tracker[w].weights_message_sent = True
             self.server.maybe_checkpoint()
-            if (log_metrics and self.server.test_x is not None
-                    and clock % self.cfg.eval_every == 0):
-                # range mode: theta is the padded sharded vector; eval on
-                # the reassembled flat layout (just stored on the server)
-                eval_theta = (jnp.asarray(self.server.theta) if range_mode
-                              else theta)
-                m = self.server.task.evaluate(eval_theta, self.server.test_x,
-                                              self.server.test_y)
-                self.server.last_metrics = m
+            if log_metrics and self.server.test_x is not None:
+                is_eval = clock % self.cfg.eval_every == 0
+                m = None
+                if is_eval:
+                    # range mode: theta is the padded sharded vector;
+                    # eval on the reassembled flat layout (just stored)
+                    eval_theta = (jnp.asarray(self.server.theta)
+                                  if range_mode else theta)
+                    m = self.server.task.evaluate(
+                        eval_theta, self.server.test_x, self.server.test_y)
+                    self.server.last_metrics = m
                 now = int(time.time() * 1000)
                 # multi-process: the server line is process 0's alone
-                # (identical replicated metrics; one writer per file)
-                if not multiproc or jax.process_index() == 0:
-                    self.server.log(
-                        f"{now};-1;{clock};{float(m.loss)};"
-                        f"{float(m.f1)};{float(m.accuracy)}")
-                # Worker log lines, same schema/cadence as the per-node
-                # path (WorkerTrainingProcessor.java:85-92).  The fused
-                # step returns the mean local training loss; test metrics
-                # are identical across workers under BSP (replicated
-                # weights), so each line carries the shared values.  Each
+                # (identical replicated metrics; one writer per file).
+                # Metric fields stay device futures (asynclog) so the
+                # next chunk dispatches while the eval completes.
+                if is_eval and (not multiproc or jax.process_index() == 0):
+                    asynclog.submit_or_write(
+                        self.server.log, f"{now};-1;{clock};{{}};{{}};{{}}",
+                        m.loss, m.f1, m.accuracy)
+                # Worker log lines, same schema AND CADENCE as the
+                # per-node path (WorkerTrainingProcessor.java:85-92):
+                # one row per worker per CLOCK — off-cadence clocks log
+                # the reference's -1 placeholders, eval clocks the
+                # shared test metrics (identical across workers under
+                # BSP — replicated weights).  Rows go out CLOCK-major
+                # so a same-millisecond batch keeps the logged spread
+                # within the BSP bound (the staleness auditor orders
+                # ties by file order).  A chunk logs each of its r
+                # rounds with that round's mean local loss.  Each
                 # process logs only the workers it hosts (its sink path
                 # is process-suffixed in multi-host mode, cli/run.py).
-                for w in feed:
-                    self.workers[w].log(
-                        f"{now};{w};{clock};{float(mean_loss)};"
-                        f"{float(m.f1)};{float(m.accuracy)};"
-                        f"{self.buffers[w].num_tuples_seen}")
+                for i in range(r):
+                    ci = clock - r + 1 + i
+                    round_loss = (losses[i] if losses is not None
+                                  else mean_loss)
+                    ci_eval = is_eval and ci == clock
+                    f1 = m.f1 if ci_eval else -1.0
+                    acc = m.accuracy if ci_eval else -1.0
+                    for w in feed:
+                        asynclog.submit_or_write(
+                            self.workers[w].log,
+                            f"{now};{w};{ci};{{}};{{}};{{}};"
+                            f"{self.buffers[w].num_tuples_seen}",
+                            round_loss, f1, acc)
+        self.flush_logs()    # deferred rows out before the loop returns
 
     def stop(self) -> None:
         self._stop.set()

@@ -104,11 +104,20 @@ class DeferredSink:
 
     def __call__(self, line: str) -> None:
         with self._lock:
-            if not self._pending and self._thread is None:
-                # pure-string sink so far: emit straight through
-                self._sink(line)
+            if self._pending or self._thread is not None:
+                self._pending.append((line, ()))
                 return
-            self._pending.append((line, ()))
+        # pure-string sink right now: emit straight through — under the
+        # emit lock, because the drain thread may since have idle-exited
+        # mid-_emit_batch and an unlocked write here could land between
+        # a batch's earlier rows (the FIFO the auditor's tie-breaking
+        # relies on).  Re-check under both locks before writing.
+        with self._emit_lock:
+            with self._lock:
+                if self._pending or self._thread is not None:
+                    self._pending.append((line, ()))
+                    return
+            self._sink(line)
 
     # -- drain side --------------------------------------------------------
 

@@ -1,0 +1,201 @@
+"""Exercise the deployment artifacts (VERDICT r3 task 9 / r4 missing
+#1): the reference's `kubernetes/server.yaml` + `worker.yaml` +
+`Dockerfile_server` + `dev/docker-compose.yaml` were run in anger;
+unvalidated YAML is documentation, not a deployment story.
+
+Three layers, so the manifests are exercised on every CI run even in
+images without k8s/docker tooling:
+
+  1. structural validation (always): every manifest parses, has the
+     kinds/containers it claims, and its Service/port/DNS wiring is
+     internally consistent;
+  2. CLI-surface validation (always): container `args` are parsed by
+     the SAME argparse parsers the entrypoints use — a flag renamed in
+     `cli/` without updating a manifest fails the suite;
+  3. tool smoke (when available): `kubectl apply --dry-run` over the
+     k8s manifests, `docker build` of deploy/Dockerfile — skipped with
+     a reason when the binary is absent (this image has neither).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEPLOY = os.path.join(REPO, "deploy")
+K8S_MANIFESTS = ("k8s/job.yaml", "k8s/split.yaml")
+
+
+def _load(relpath: str) -> list[dict]:
+    with open(os.path.join(DEPLOY, relpath)) as fh:
+        return [d for d in yaml.safe_load_all(fh) if d is not None]
+
+
+def _containers(doc: dict) -> list[dict]:
+    spec = doc["spec"]
+    if doc["kind"] == "Job":
+        return spec["template"]["spec"]["containers"]
+    if doc["kind"] == "Deployment":
+        return spec["template"]["spec"]["containers"]
+    raise AssertionError(f"unexpected kind {doc['kind']}")
+
+
+@pytest.mark.parametrize("relpath", K8S_MANIFESTS)
+def test_k8s_manifests_parse_and_have_required_structure(relpath):
+    docs = _load(relpath)
+    kinds = [d["kind"] for d in docs]
+    assert "Service" in kinds
+    assert any(k in ("Job", "Deployment") for k in kinds)
+    for d in docs:
+        assert d["apiVersion"]
+        assert d["metadata"]["name"]
+        if d["kind"] in ("Job", "Deployment"):
+            for c in _containers(d):
+                assert c["image"]
+                assert c.get("args") or c.get("command")
+
+
+def test_split_manifest_mirrors_reference_two_role_topology():
+    """deploy/k8s/split.yaml is the counterpart of the reference's
+    kubernetes/server.yaml + worker.yaml: one server Deployment behind
+    a Service, one worker Deployment dialing it."""
+    docs = {(d["kind"], d["metadata"]["name"]): d
+            for d in _load("k8s/split.yaml")}
+    service = docs[("Service", "kps-server")]
+    server = docs[("Deployment", "kps-server")]
+    worker = docs[("Deployment", "kps-worker")]
+
+    # service routes to the server pods on the port --listen binds
+    port = service["spec"]["ports"][0]["port"]
+    (sc,) = _containers(server)
+    args = sc["args"]
+    assert args[args.index("--listen") + 1] == str(port)
+    assert service["spec"]["selector"] == \
+        server["spec"]["selector"]["matchLabels"]
+    assert sc["ports"][0]["containerPort"] == port
+
+    # the worker dials the service DNS name on the same port
+    (wc,) = _containers(worker)
+    connect = wc["args"][wc["args"].index("--connect") + 1]
+    assert connect == f"kps-server:{port}"
+
+    # the aggregator is singular, like the reference's server JVM
+    assert server["spec"]["replicas"] == 1
+
+    # worker ids cover --num_workers (every logical worker is hosted)
+    ids = wc["args"][wc["args"].index("--worker_ids") + 1]
+    n = int(sc["args"][sc["args"].index("--num_workers") + 1])
+    assert sorted(int(i) for i in ids.split(",")) == list(range(n))
+
+
+def _parse_with(parser, args: list[str]):
+    """parse_args that FAILS the test (not SystemExit) on unknown flags."""
+    parsed, extra = parser.parse_known_args(args)
+    assert not extra, f"manifest args not accepted by the CLI: {extra}"
+    return parsed
+
+
+def test_split_manifest_args_parse_against_the_real_cli_surfaces():
+    from kafka_ps_tpu.cli import server_runner, worker_runner
+
+    docs = {(d["kind"], d["metadata"]["name"]): d
+            for d in _load("k8s/split.yaml")}
+    (sc,) = _containers(docs[("Deployment", "kps-server")])
+    assert sc["command"][-1] == "kafka_ps_tpu.cli.server_runner"
+    sargs = _parse_with(server_runner.build_parser(), sc["args"])
+    assert sargs.listen == 8477 and sargs.consistency_model == 10
+    assert sargs.failure_policy == "rebalance"
+
+    (wc,) = _containers(docs[("Deployment", "kps-worker")])
+    assert wc["command"][-1] == "kafka_ps_tpu.cli.worker_runner"
+    wargs = _parse_with(worker_runner.build_parser(), wc["args"])
+    assert wargs.connect == "kps-server:8477"
+    assert wargs.worker_ids == "0,1,2,3"
+
+
+def test_job_manifest_args_parse_and_encode_the_kps_contract():
+    from kafka_ps_tpu.cli import run as run_mod
+
+    docs = {d["kind"]: d for d in _load("k8s/job.yaml")}
+    job = docs["Job"]
+    (c,) = _containers(job)
+    args = _parse_with(run_mod.build_parser(), c["args"])
+    assert args.fused and args.remote            # the multi-host path
+
+    env = {e["name"]: e for e in c["env"]}
+    # the KPS_* rendezvous contract (parallel/multihost.py)
+    assert {"KPS_COORDINATOR", "KPS_NUM_PROCESSES",
+            "KPS_PROCESS_ID"} <= set(env)
+    nprocs = int(env["KPS_NUM_PROCESSES"]["value"])
+    assert job["spec"]["completions"] == nprocs
+    assert job["spec"]["parallelism"] == nprocs
+    assert job["spec"]["completionMode"] == "Indexed"
+    # coordinator DNS: pod 0 of the job through the headless service
+    svc = docs["Service"]
+    coord = env["KPS_COORDINATOR"]["value"]
+    assert svc["metadata"]["name"] in coord
+    assert coord.endswith(f":{svc['spec']['ports'][0]['port']}")
+
+
+def test_compose_args_parse_and_share_one_rendezvous():
+    from kafka_ps_tpu.cli import run as run_mod
+
+    with open(os.path.join(DEPLOY, "docker-compose.yaml")) as fh:
+        compose = yaml.safe_load(fh)
+    services = compose["services"]
+    assert len(services) >= 2
+    coords = set()
+    for name, svc in services.items():
+        parsed = _parse_with(run_mod.build_parser(), svc["command"])
+        assert parsed.fused and parsed.remote
+        env = svc["environment"]
+        coords.add(env["KPS_COORDINATOR"])
+        assert int(env["KPS_PROCESS_ID"]) in range(
+            int(env["KPS_NUM_PROCESSES"]))
+    assert len(coords) == 1, "all processes must share one coordinator"
+
+
+def test_dockerfile_references_exist():
+    """The image builds from real repo paths and enters the real CLI."""
+    with open(os.path.join(DEPLOY, "Dockerfile")) as fh:
+        content = fh.read()
+    for line in content.splitlines():
+        if line.startswith("COPY "):
+            src = line.split()[1]
+            assert os.path.exists(os.path.join(REPO, src)), line
+    assert "kafka_ps_tpu.cli.run" in content       # entrypoint module
+    import importlib
+    assert importlib.util.find_spec("kafka_ps_tpu.cli.run")
+
+
+# -- tool smoke (skipped where the binary is absent) -------------------------
+
+kubectl = shutil.which("kubectl")
+docker = shutil.which("docker")
+
+
+@pytest.mark.skipif(kubectl is None,
+                    reason="kubectl not installed in this image")
+@pytest.mark.parametrize("relpath", K8S_MANIFESTS)
+def test_kubectl_dry_run_validates_manifests(relpath):
+    proc = subprocess.run(
+        [kubectl, "apply", "--dry-run=client", "--validate=true",
+         "-f", os.path.join(DEPLOY, relpath)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+@pytest.mark.skipif(docker is None,
+                    reason="docker not installed in this image")
+@pytest.mark.slow
+def test_docker_build_smoke():
+    proc = subprocess.run(
+        [docker, "build", "-f", os.path.join(DEPLOY, "Dockerfile"),
+         "-t", "kafka-ps-tpu-smoke", REPO],
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-3000:]

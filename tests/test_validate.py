@@ -303,3 +303,53 @@ def test_epochs_late_last_gasp_warns():
     events = [(10, "evict", 1)]
     with pytest.warns(UserWarning, match="after its eviction"):
         assert _elastic(rows, events, k=0) == []
+
+
+def test_crash_truncated_epoch_tail_does_not_fake_staleness():
+    """Split-mode SIGKILL loses a worker's final deferred log rows
+    (utils/asynclog.py), so its logged clock understates its protocol
+    clock — the spread in an epoch that ends in a crash-resume must
+    drop a worker once its log goes silent for the rest of the epoch."""
+    rows = []
+    # worker 1's log stops at clock 1 (tail lost to the SIGKILL);
+    # worker 0 keeps logging to clock 9 — apparent spread 8 > bound 3+1
+    for c in range(2):
+        rows.append({"timestamp": 1000 + 10 * c, "partition": 1,
+                     "vectorClock": c})
+    for c in range(10):
+        rows.append({"timestamp": 1001 + 10 * c, "partition": 0,
+                     "vectorClock": c})
+    # post-resume both workers re-walk from the checkpoint clocks
+    for c in range(2, 6):
+        rows.append({"timestamp": 5000 + 10 * c, "partition": 1,
+                     "vectorClock": c})
+        rows.append({"timestamp": 5001 + 10 * c, "partition": 0,
+                     "vectorClock": c + 1})
+    df = pd.DataFrame(rows)
+    events = [(3000, "resume", -1)]
+    assert validate.validate_worker_log(df, 3,
+                                        membership_events=events) == []
+
+    # the SAME truncated shape WITHOUT a resume ahead is a real
+    # staleness violation — the exemption is crash-scoped, not general
+    df_live = pd.DataFrame(rows[:12])
+    v = validate.validate_worker_log(df_live, 3, elastic=True,
+                                     membership_events=[])
+    assert any(x.rule == "staleness-bound" for x in v)
+
+
+def test_membership_events_auto_enable_epoch_auditing():
+    """Passing membership events without elastic=True must still take
+    the epoch-aware path: the static contract is provably void across
+    evict/readmit/resume events (a halt-crash resume rewinds clocks)."""
+    rows = [{"timestamp": 1000 + 10 * c, "partition": 0, "vectorClock": c}
+            for c in range(4)]
+    rows += [{"timestamp": 2000 + 10 * i, "partition": 0,
+              "vectorClock": c}                    # rewound re-walk
+             for i, c in enumerate(range(2, 5))]
+    df = pd.DataFrame(rows)
+    events = [(1500, "resume", -1)]
+    # no elastic flag: previously took the static +1 path and flagged
+    # the rewind; now auto-routes to the epoch auditor
+    assert validate.validate_worker_log(df, 0,
+                                        membership_events=events) == []
