@@ -328,7 +328,10 @@ def run_worker(args) -> int:
         from kafka_ps_tpu.utils import checkpoint as ckpt
         state_stop = threading.Event()
 
-        state_every = getattr(args, "state_every", 1.0) or 1.0
+        state_every = getattr(args, "state_every", 1.0)
+        if state_every is None or state_every <= 0:
+            raise SystemExit("--state_every must be > 0 (seconds between "
+                             "durable buffer snapshots)")
 
         def state_saver():
             # the changelog analogue: snapshot on a cadence (the

@@ -503,9 +503,17 @@ class StreamingPSApp:
                     mean_loss = losses[-1]
                 else:
                     theta, mean_loss = step(theta, x, y, mask)
-                if self.tracer.enabled:
+                if self.tracer.enabled or (multiproc and log_metrics):
                     # sync so the span measures the real step, not the
-                    # async dispatch; untraced runs keep pipelining
+                    # async dispatch.  Multi-process runs with logging
+                    # ALSO sync here: the psum makes every process's
+                    # step k finish together on device, and blocking
+                    # the hosts on it keeps their row timestamps
+                    # aligned per clock — fully async hosts submit all
+                    # their rows (and stamp them) way ahead of the
+                    # device, and the auditor's cross-file
+                    # timestamp-sorted spread becomes fiction.
+                    # Untraced single-process runs keep pipelining.
                     mean_loss = float(mean_loss)
             self.tracer.count("bsp.steps")
             clock += r
