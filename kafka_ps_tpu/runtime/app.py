@@ -435,21 +435,28 @@ class StreamingPSApp:
         from kafka_ps_tpu.parallel import range_sharded
 
         # Chunking: stretches with no eval boundary run CHUNK rounds as
-        # ONE lax.scan dispatch (bsp.make_bsp_multi_step) — without it
-        # the runtime pays a full dispatch round-trip per round and
+        # ONE lax.scan dispatch (bsp.make_bsp_multi_step /
+        # range_sharded.make_range_sharded_step(rounds=CHUNK)) — without
+        # it the runtime pays a full dispatch round-trip per round and
         # falls to ~1/4 of the kernel rate at MLP-4096 (BENCH r5; the
         # "framework adds no overhead that survives scale" claim,
         # docs/ROOFLINE.md).  Eval cadences land exactly: a chunk never
         # crosses an eval clock, and eval_every=1 degenerates to the
-        # per-round path.  Range-sharded mode has no multi-step program
-        # (parallel/range_sharded.py) and always steps singly.
+        # per-round path.
         CHUNK = self.FUSED_CHUNK_ROUNDS
 
         def get_multi_step():
             if "multi_step" not in progs:
-                progs["multi_step"] = bsp.make_bsp_multi_step(
-                    self.cfg.model, len(active), self.cfg.server_lr,
-                    CHUNK, mesh=mesh, task=task)
+                if range_mode:
+                    progs["multi_step"] = \
+                        range_sharded.make_range_sharded_step(
+                            self.cfg.model, len(active),
+                            self.cfg.server_lr, mesh, rounds=CHUNK,
+                            task=task)
+                else:
+                    progs["multi_step"] = bsp.make_bsp_multi_step(
+                        self.cfg.model, len(active), self.cfg.server_lr,
+                        CHUNK, mesh=mesh, task=task)
             return progs["multi_step"]
 
         x = y = mask = None
@@ -493,7 +500,7 @@ class StreamingPSApp:
             if log_metrics and self.server.test_x is not None:
                 r = min(r, self.cfg.eval_every
                         - (clock % self.cfg.eval_every))
-            use_chunk = r == CHUNK and not range_mode
+            use_chunk = r == CHUNK
             if not use_chunk:
                 r = 1
             losses = None

@@ -347,3 +347,76 @@ def test_threaded_run_emits_status_lines(capsys):
     assert status_lines, err
     assert "clocks=" in status_lines[-1]
     assert "buffers=" in status_lines[-1]
+
+
+def test_fused_chunking_keeps_per_clock_log_cadence(tmp_path, monkeypatch):
+    """eval_every > 1 engages the multi-round chunk dispatch
+    (StreamingPSApp.FUSED_CHUNK_ROUNDS): the worker log must still carry
+    one row per worker per CLOCK (the per-node cadence,
+    WorkerTrainingProcessor.java:85-92) — off-cadence rows with the
+    reference's -1 placeholders, eval rows with shared metrics — and the
+    combined logs must stay auditor-clean under the sequential
+    contract."""
+    import pandas as pd
+
+    from kafka_ps_tpu.cli import run as run_mod
+    from kafka_ps_tpu.data.synth import generate, write_csv
+    from kafka_ps_tpu.evaluation import validate
+
+    monkeypatch.chdir(tmp_path)
+    x, y = generate(460, 16, 3, noise=1.0, sparsity=0.5, seed=0)
+    write_csv("train.csv", x[:400], y[:400])
+    write_csv("test.csv", x[400:], y[400:])
+    args = run_mod.build_parser().parse_args(
+        ["-training", "train.csv", "-test", "test.csv",
+         "--num_features", "16", "--num_classes", "3",
+         "--num_workers", "4", "-p", "1", "-l", "--fused",
+         "--eval_every", "10", "--max_iterations", "160",
+         "--local_learning_rate", "0.1"])
+    assert run_mod.run_with_args(args) == 0
+
+    w = pd.read_csv("logs-worker.csv", sep=";")
+    s = pd.read_csv("logs-server.csv", sep=";")
+    # 160 iterations / 4 workers = 40 clocks, EVERY clock logged
+    for wk, g in w.groupby("partition"):
+        assert g["vectorClock"].tolist() == list(range(1, 41))
+    # off-cadence rows carry the reference's -1 placeholders; eval rows
+    # carry real shared metrics
+    off = w[w["vectorClock"] % 10 != 0]
+    assert (off["fMeasure"] == -1).all() and (off["accuracy"] == -1).all()
+    on = w[w["vectorClock"] % 10 == 0]
+    assert (on["fMeasure"] > 0).all()
+    assert (off["loss"] != -1).any()         # per-round losses are real
+    # server evals exactly on cadence
+    assert s["vectorClock"].tolist() == [10, 20, 30, 40]
+    assert validate.validate_run(w, s, consistency_model=0) == []
+
+
+def test_fused_chunking_range_sharded_mesh(tmp_path, monkeypatch):
+    """The chunked dispatch also drives the range-sharded 2-D mesh
+    (range_sharded.make_range_sharded_step(rounds=CHUNK)): same per-clock
+    cadence and contract on the virtual 8-device mesh."""
+    import pandas as pd
+
+    from kafka_ps_tpu.cli import run as run_mod
+    from kafka_ps_tpu.data.synth import generate, write_csv
+    from kafka_ps_tpu.evaluation import validate
+
+    monkeypatch.chdir(tmp_path)
+    x, y = generate(460, 16, 3, noise=1.0, sparsity=0.5, seed=0)
+    write_csv("train.csv", x[:400], y[:400])
+    write_csv("test.csv", x[400:], y[400:])
+    args = run_mod.build_parser().parse_args(
+        ["-training", "train.csv", "-test", "test.csv",
+         "--num_features", "16", "--num_classes", "3",
+         "--num_workers", "8", "-p", "1", "-l", "--fused",
+         "--param_shards", "2", "--eval_every", "8",
+         "--max_iterations", "192", "--local_learning_rate", "0.1"])
+    assert run_mod.run_with_args(args) == 0
+
+    w = pd.read_csv("logs-worker.csv", sep=";")
+    s = pd.read_csv("logs-server.csv", sep=";")
+    for wk, g in w.groupby("partition"):
+        assert g["vectorClock"].tolist() == list(range(1, 25))
+    assert validate.validate_run(w, s, consistency_model=0) == []
+    assert s["loss"].iloc[-1] < s["loss"].iloc[0]
