@@ -299,39 +299,58 @@ def main() -> None:
     th1 = jnp.asarray(theta)
     on_tpu = jax.default_backend() == "tpu"
 
-    pallas_ab = None
-    if on_tpu and fused_update.fits_in_vmem(buffer_cap, cfg.num_features):
-        fns = {
-            "xla": lambda: logreg.local_update(th1, x1, y1, m1, cfg=cfg)[0],
-            "pallas": lambda: fused_update.local_update(
-                th1, x1, y1, m1, cfg=cfg, allow_fallback=False)[0],
-        }
+    reps = 100
+
+    def many(fn):
+        # pipeline `reps` async dispatches, sync once: measures the
+        # per-call device cost, not the tunnel's per-call host
+        # round-trip (which swamps any kernel difference)
+        def go():
+            last = None
+            for _ in range(reps):
+                last = fn()
+            jax.block_until_ready(last)
+        return go
+
+    def run_ab(fns: dict) -> dict:
         for f in fns.values():
             np.asarray(f())              # compile both before timing
-        reps = 100
-
-        def many(fn):
-            # pipeline `reps` async dispatches, sync once: measures the
-            # per-call device cost, not the tunnel's per-call host
-            # round-trip (which swamps any kernel difference)
-            def go():
-                last = None
-                for _ in range(reps):
-                    last = fn()
-                jax.block_until_ready(last)
-            return go
-
         ab = interleaved_rates({k: many(f) for k, f in fns.items()},
                                reps, trials=5)
         xla_s, pal_s = rate_stats(ab["xla"]), rate_stats(ab["pallas"])
-        pallas_ab = {
+        return {
             "xla_local_updates_per_sec": xla_s,
             "pallas_local_updates_per_sec": pal_s,
             "pallas_speedup": round(pal_s["median"] / xla_s["median"], 3),
         }
 
+    pallas_ab = None
+    if on_tpu and fused_update.fits_in_vmem(buffer_cap, cfg.num_features):
+        pallas_ab = run_ab({
+            "xla": lambda: logreg.local_update(th1, x1, y1, m1, cfg=cfg)[0],
+            "pallas": lambda: fused_update.local_update(
+                th1, x1, y1, m1, cfg=cfg, allow_fallback=False)[0],
+        })
+
     # -- fused MLP task (second model family), kernel-level ----------------
     mlp_task = get_task("mlp", cfg)
+
+    # pallas vs XLA for the MLP family at reference shapes (H=128)
+    pallas_ab_mlp = None
+    if on_tpu and fused_update.mlp_fits_in_vmem(buffer_cap,
+                                                cfg.num_features,
+                                                cfg.hidden_dim):
+        th_mlp = mlp_task.init_params()
+        # one jitted program for the XLA arm (one_hot folded in): the
+        # plain method call would pay an extra eager dispatch per call,
+        # inflating the pallas speedup on a dispatch-dominated transport
+        mlp_xla = jax.jit(
+            lambda t, xx, yy, mm: mlp_task.local_update(t, xx, yy, mm))
+        pallas_ab_mlp = run_ab({
+            "xla": lambda: mlp_xla(th_mlp, x1, y1, m1)[0],
+            "pallas": lambda: fused_update.mlp_local_update(
+                th_mlp, x1, y1, m1, cfg=cfg, allow_fallback=False)[0],
+        })
     mlp_step = bsp.make_bsp_multi_step(cfg, num_workers, server_lr,
                                        rounds_per_call, task=mlp_task)
     mlp_state = {"theta": mlp_step(mlp_task.init_params(),
@@ -459,6 +478,7 @@ def main() -> None:
                 "fused_mlp_rounds_per_sec": mlp_rounds,
                 "mlp4096_full_runtime": mlp4096,
                 "pallas_ab": pallas_ab,
+                "pallas_ab_mlp": pallas_ab_mlp,
                 "per_node_iters_per_sec_eval_every_1": per_node_ref_cadence,
                 "per_node_iters_per_sec_eval_every_10": per_node_eval10,
             },

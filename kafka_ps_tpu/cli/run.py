@@ -101,8 +101,9 @@ def build_parser(include_server_flags: bool = True,
                         "logdir) for the whole run")
     p.add_argument("--pallas", action="store_true",
                    help="use the Pallas fused local-update kernel for "
-                        "worker iterations (ops/fused_update.py; "
-                        "auto-falls-back off-TPU)")
+                        "worker iterations — logreg and mlp families "
+                        "(ops/fused_update.py; auto-falls-back off-TPU "
+                        "or past the VMEM budget)")
     p.add_argument("--failure_policy", choices=["halt", "rebalance"],
                    default="halt",
                    help="threaded mode: evict crashed/hung workers and "
@@ -214,10 +215,10 @@ def run_with_args(args) -> int:
     if getattr(args, "param_shards", 1) > 1 and not args.fused:
         raise SystemExit("--param_shards requires --fused (the "
                          "range-sharded server is a fused-mesh mode)")
-    if args.pallas and args.task != "logreg":
+    if args.pallas and args.task not in ("logreg", "mlp"):
         raise SystemExit(
-            "--pallas implements the logreg local update only "
-            "(ops/fused_update.py); drop --pallas or use --task logreg")
+            "--pallas implements the logreg and mlp local updates "
+            f"(ops/fused_update.py); got --task {args.task}")
     distributed = False
     if args.remote:
         from kafka_ps_tpu.parallel import multihost

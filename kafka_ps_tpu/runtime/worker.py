@@ -56,9 +56,11 @@ def _solver_fns(task_name: str, cfg, use_pallas: bool):
     task = get_task(task_name, cfg)
     if use_pallas:
         from kafka_ps_tpu.ops import fused_update
+        kernel = {"logreg": fused_update.local_update,
+                  "mlp": fused_update.mlp_local_update}[task_name]
 
         def update_fn(theta, x, y, mask):
-            return fused_update.local_update(theta, x, y, mask, cfg=cfg)
+            return kernel(theta, x, y, mask, cfg=cfg)
     else:
         update_fn = task.local_update
 
@@ -87,9 +89,9 @@ class WorkerNode:
         self.buffer = buffer
         from kafka_ps_tpu.models.task import get_task
         self.task = get_task(cfg.task, cfg.model)
-        if cfg.use_pallas and cfg.task != "logreg":
+        if cfg.use_pallas and cfg.task not in ("logreg", "mlp"):
             raise ValueError(
-                "use_pallas implements the logreg local update only "
+                "use_pallas implements the logreg and mlp local updates "
                 f"(ops/fused_update.py), got task {cfg.task!r}")
         self.theta = np.zeros((self.task.num_params,), dtype=np.float32)
         self.test_x = jnp.asarray(test_x) if test_x is not None else None

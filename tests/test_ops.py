@@ -102,3 +102,118 @@ def test_out_of_range_label_loss_matches_xla_path():
     assert abs(float(loss_pl)) < 1e6         # not blown up to ~1e30
     np.testing.assert_allclose(np.asarray(d_pl), np.asarray(d_ref),
                                rtol=2e-4, atol=2e-5)
+
+
+# -- MLP family kernel (ops/fused_update.mlp_local_update) -------------------
+
+MLP_CFG = ModelConfig(num_features=64, num_classes=5, hidden_dim=32)
+
+
+def _mlp_task(cfg=MLP_CFG):
+    from kafka_ps_tpu.models.mlp import MLPTask
+    return MLPTask(cfg)
+
+
+def test_mlp_kernel_matches_xla_path():
+    x, y, mask = _batch(cfg=MLP_CFG)
+    task = _mlp_task()
+    theta = task.init_params()
+    d_ref, loss_ref = task.local_update(theta, x, y, mask)
+    d_pl, loss_pl = fused_update.mlp_local_update(theta, x, y, mask,
+                                                  cfg=MLP_CFG,
+                                                  interpret=True)
+    np.testing.assert_allclose(np.asarray(d_pl), np.asarray(d_ref),
+                               rtol=2e-4, atol=2e-5)
+    assert float(loss_pl) == pytest.approx(float(loss_ref), rel=2e-4)
+
+
+def test_mlp_kernel_hidden_not_lane_multiple():
+    # hidden=32 < 128 exercises the H padding; hidden=160 crosses one
+    # lane boundary (padded to 256) — padded units must stay exactly 0
+    cfg = ModelConfig(num_features=64, num_classes=5, hidden_dim=160)
+    x, y, mask = _batch(n=37, cfg=cfg)        # + odd batch padding
+    task = _mlp_task(cfg)
+    theta = task.init_params()
+    d_ref, _ = task.local_update(theta, x, y, mask)
+    d_pl, _ = fused_update.mlp_local_update(theta, x, y, mask, cfg=cfg,
+                                            interpret=True)
+    np.testing.assert_allclose(np.asarray(d_pl), np.asarray(d_ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_mlp_kernel_all_masked_rows_no_nan():
+    x, y, _ = _batch(n=16, cfg=MLP_CFG)
+    mask = jnp.zeros((16,), jnp.float32)
+    d, loss = fused_update.mlp_local_update(_mlp_task().init_params(),
+                                            x, y, mask, cfg=MLP_CFG,
+                                            interpret=True)
+    assert np.isfinite(np.asarray(d)).all()
+    assert np.isfinite(float(loss))
+
+
+def test_mlp_oversize_hidden_falls_back():
+    assert not fused_update.mlp_fits_in_vmem(1024, 1024, 4096)
+    cfg = ModelConfig(num_features=1024, num_classes=5, hidden_dim=4096)
+    task = _mlp_task(cfg)
+    x, y, mask = _batch(n=16, cfg=cfg)
+    with pytest.raises(ValueError, match="mlp_local_update unavailable"):
+        fused_update.mlp_local_update(task.init_params(), x, y, mask,
+                                      cfg=cfg, interpret=True,
+                                      allow_fallback=False)
+    d, loss = fused_update.mlp_local_update(task.init_params(), x, y,
+                                            mask, cfg=cfg, interpret=True)
+    d_ref, _ = task.local_update(task.init_params(), x, y, mask)
+    np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref),
+                               rtol=1e-6, atol=1e-7)
+    assert np.isfinite(float(loss))
+
+
+def test_worker_pallas_dispatch_accepts_both_families():
+    """--pallas dispatches by task family in the per-node worker path
+    (runtime/worker._solver_fns); off-TPU both kernels fall back to
+    their XLA paths, so the worker trains normally."""
+    from kafka_ps_tpu.data.buffer import SlidingBuffer
+    from kafka_ps_tpu.runtime import fabric as fabric_mod
+    from kafka_ps_tpu.runtime.messages import KeyRange, WeightsMessage
+    from kafka_ps_tpu.runtime.worker import WorkerNode
+    from kafka_ps_tpu.utils.config import BufferConfig, PSConfig
+
+    for task_name in ("logreg", "mlp"):
+        cfg = PSConfig(
+            num_workers=1, task=task_name, use_pallas=True,
+            model=ModelConfig(num_features=16, num_classes=3,
+                              hidden_dim=8),
+            buffer=BufferConfig(min_size=4, max_size=32))
+        buf = SlidingBuffer(16, cfg.buffer)
+        x, y = generate(12, 16, 3, seed=0)
+        for i in range(12):
+            buf.add(dict(enumerate(x[i])), int(y[i]))
+        fab = fabric_mod.Fabric()
+        node = WorkerNode(0, cfg, fab, buf)
+        node.on_weights(WeightsMessage(
+            vector_clock=0,
+            key_range=KeyRange(0, node.task.num_params),
+            values=jnp.zeros(node.task.num_params)
+            if task_name == "logreg" else node.task.init_params()))
+        g = fab.poll(fabric_mod.GRADIENTS_TOPIC, 0)
+        assert g is not None
+        assert np.isfinite(np.asarray(g.values)).all()
+
+
+def test_mlp_out_of_range_label_matches_jax_grad_semantics():
+    """An out-of-range label row must contribute ZERO gradient in the
+    MLP kernel — jax.grad of the one-hot CE (the XLA path) differentiates
+    through an all-zero one-hot row, unlike logreg's closed form which
+    keeps the softmax term (the two families deliberately differ;
+    each kernel matches ITS OWN XLA path)."""
+    x, y, mask = _batch(n=16, cfg=MLP_CFG)
+    y = y.at[3].set(MLP_CFG.num_classes + 7)
+    task = _mlp_task()
+    theta = task.init_params()
+    d_ref, loss_ref = task.local_update(theta, x, y, mask)
+    d_pl, loss_pl = fused_update.mlp_local_update(theta, x, y, mask,
+                                                  cfg=MLP_CFG,
+                                                  interpret=True)
+    np.testing.assert_allclose(np.asarray(d_pl), np.asarray(d_ref),
+                               rtol=2e-4, atol=2e-5)
+    assert float(loss_pl) == pytest.approx(float(loss_ref), rel=2e-4)
