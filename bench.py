@@ -451,10 +451,11 @@ def main() -> None:
             app.run_serial(max_server_iterations=state["done"])
 
         run()                                       # warm (caches hot)
+        run()                                       # settle the tunnel
         return rate_stats(timed_rates(run, iters, trials), round_to=2)
 
-    per_node_ref_cadence = per_node_stats(1, 40, trials=3)
-    per_node_eval10 = per_node_stats(10, 80, trials=3)
+    per_node_ref_cadence = per_node_stats(1, 40, trials=5)
+    per_node_eval10 = per_node_stats(10, 80, trials=5)
 
     baseline = 1.85   # best aggregate worker-updates/s in reference logs
     print(json.dumps({
