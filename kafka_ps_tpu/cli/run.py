@@ -119,6 +119,19 @@ def build_parser(include_server_flags: bool = True,
                         "(improvement over the reference's cold start)")
     p.add_argument("--checkpoint_every", type=int, default=50,
                    help="server iterations between checkpoint saves")
+    p.add_argument("--durable-log", dest="durable_log", default=None,
+                   metavar="DIR",
+                   help="persist every WEIGHTS/GRADIENTS/INPUT_DATA "
+                        "message to a segmented commit log under DIR "
+                        "(kafka_ps_tpu/log/ — the reference's Kafka "
+                        "broker durability); on restart the run replays "
+                        "the unconsumed tail past the last checkpoint's "
+                        "committed offsets (docs/DURABILITY.md)")
+    p.add_argument("--fsync", choices=["none", "interval", "always"],
+                   default="interval",
+                   help="--durable-log fsync policy: page-cache only / "
+                        "at most once per second / every append "
+                        "(log/log.py)")
     return p
 
 
@@ -179,9 +192,16 @@ def make_app_from_args(args, resuming: bool = False,
     if getattr(args, "trace", None):
         from kafka_ps_tpu.utils.trace import Tracer
         tracer = Tracer()
+    fabric = None
+    if getattr(args, "durable_log", None):
+        from kafka_ps_tpu.log import DurableFabric, LogConfig
+        fabric = DurableFabric(
+            args.durable_log,
+            LogConfig(fsync=getattr(args, "fsync", "interval")),
+            tracer=tracer)
     app = StreamingPSApp(cfg, test_x=test_x, test_y=test_y,
                          server_log=server_log, worker_log=worker_log,
-                         tracer=tracer)
+                         tracer=tracer, fabric=fabric)
     return app, (server_log, worker_log)
 
 
@@ -225,6 +245,13 @@ def run_with_args(args) -> int:
         # join the job BEFORE building the app: process identity gates
         # the log sinks and checkpoint writer below
         distributed = multihost.initialize()
+        if distributed and getattr(args, "durable_log", None):
+            # the commit log is single-writer per partition; a
+            # multi-host job would need per-host roots + a replicated
+            # offsets store (ROADMAP)
+            raise SystemExit(
+                "--durable-log is single-process; a multi-host job "
+                "must run without it (use --checkpoint for resume)")
         if distributed and not args.fused:
             # only the fused BSP step runs over the global mesh; the
             # host-orchestrated modes are single-host by design
@@ -277,6 +304,15 @@ def run_with_args(args) -> int:
             app.server.checkpoint_every = args.checkpoint_every
             app.server.checkpoint_buffers = ckpt_buffers
 
+    if getattr(args, "durable_log", None):
+        # replay the unconsumed tail past the restored checkpoint's
+        # offsets (or the committed ones) BEFORE the producer starts:
+        # recovery re-enqueues in-flight weights/gradients, refills the
+        # buffers' post-checkpoint rows, and arms the re-ingestion skip
+        counts = app.recover_durable()
+        if args.verbose:
+            print(f"    durable-log replay: {counts}")
+
     # mesh + data-partition assignment come AFTER checkpoint restore: a
     # restored checkpoint can carry evictions, and both the divisibility
     # check and the local-worker filter must see the real membership
@@ -318,6 +354,7 @@ def run_with_args(args) -> int:
     producer = app.make_producer(args.training_data_file_path)
     producer.run_in_background()
     app.wait_for_prefill(min_per_worker=1, timeout=120.0)
+    app.wait_for_stream_settle(producer)
 
     max_iters = args.max_iterations or sys.maxsize
     from kafka_ps_tpu.utils.trace import device_trace
@@ -346,9 +383,11 @@ def run_with_args(args) -> int:
         # drain threads dispatch device fetches
         producer.stop()
         if args.checkpoint and process_index == 0:
-            from kafka_ps_tpu.utils import checkpoint as ckpt
-            ckpt.save(args.checkpoint, app.server,
-                      buffers=app.server.checkpoint_buffers)
+            # routed through the server so a durable fabric commits the
+            # offsets this final snapshot covers (a commit point)
+            app.server.save_checkpoint_now()
+        if getattr(args, "durable_log", None):
+            app.fabric.close()
         app.close_logs()
         for log in logs:
             log.close()

@@ -40,6 +40,13 @@ def main(argv=None) -> int:
     args = argparse.Namespace(min_buffer_size=128, max_buffer_size=1024,
                               buffer_size_coefficient=0.3, **vars(args))
     if args.listen is not None:
+        if getattr(args, "durable_log", None):
+            # the socket split already has its own durability story
+            # (--checkpoint + per-worker state files, cli/socket_mode);
+            # the commit log is the in-process fabric's
+            raise SystemExit(
+                "--durable-log applies to the in-process fabric; in "
+                "--listen split mode use --checkpoint instead")
         from kafka_ps_tpu.cli import socket_mode
         return socket_mode.run_server(args)
     return run_mod.run_with_args(args)

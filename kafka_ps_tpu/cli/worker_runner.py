@@ -46,6 +46,12 @@ def main(argv=None) -> int:
                               consistency_model=0,
                               producer_time_per_event=200, **vars(args))
     if args.connect is not None:
+        if getattr(args, "durable_log", None):
+            # same gate as server_runner: the split deployment's
+            # durability is --checkpoint + worker-local state files
+            raise SystemExit(
+                "--durable-log applies to the in-process fabric; in "
+                "--connect split mode use --checkpoint instead")
         from kafka_ps_tpu.cli import socket_mode
         return socket_mode.run_worker(args)
     return run_mod.run_with_args(args)

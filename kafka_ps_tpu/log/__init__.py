@@ -1,0 +1,32 @@
+"""Durable commit log — the Kafka primitive the fabric stood in for.
+
+The reference inherits its entire fault-tolerance story from Kafka's
+durable, offset-addressed log (Kreps et al., NetDB'11): producers
+append, the broker assigns monotonic offsets, consumers own a committed
+offset and replay from it after a crash.  `runtime/fabric.py` preserved
+the *delivery* semantics of the three topics in volatile deques; this
+package restores the *durability* semantics so "the Kafka fabric
+disappears; its semantics stay" (README) holds across process death:
+
+  * `records`   — CRC32-framed, length-prefixed record codec (the
+                  framing; payloads are `runtime/serde.py` binary);
+  * `segment`   — one append-only segment file + sparse offset index;
+  * `log`       — `CommitLog`: segmented partition log with monotonic
+                  offsets, configurable roll/retention and fsync policy;
+  * `manager`   — `LogManager`: (topic, key) partition registry +
+                  consumer groups with durable committed offsets;
+  * `durable_fabric` — `DurableFabric`: the fabric API
+                  (send/poll/poll_blocking) layered over the log, with
+                  crash recovery by replay from committed offsets.
+
+Recovery protocol (docs/DURABILITY.md): a checkpoint records the log
+offsets it covers; resume = load checkpoint + replay the log tail.
+Replayed gradient deltas are deduplicated against the tracker's vector
+clocks (`parallel/tracker.py`) so each delta is applied exactly once.
+"""
+
+from kafka_ps_tpu.log.durable_fabric import DurableFabric
+from kafka_ps_tpu.log.log import CommitLog, LogConfig
+from kafka_ps_tpu.log.manager import LogManager
+
+__all__ = ["CommitLog", "DurableFabric", "LogConfig", "LogManager"]

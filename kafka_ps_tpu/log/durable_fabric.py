@@ -1,0 +1,183 @@
+"""DurableFabric — the in-process fabric backed by the commit log.
+
+Same API as `runtime/fabric.Fabric` (send / poll / poll_blocking /
+purge / contains / pending), so every drive loop and node runs
+unchanged; each send additionally appends the message's binary serde
+frame (`runtime/serde.py`) to the partition's CommitLog before it is
+enqueued, and each poll records the delivered offset.
+
+Consumer groups (one per consuming role, mirroring the reference's
+Kafka consumer groups, BaseKafkaApp.java:27-33):
+
+    gradients  -> "server"   (the aggregator)
+    weights    -> "workers"  (one offset entry per worker key)
+    input-data -> "ingest"   (rows are consumed into buffers at
+                              persist time; the offset marks ingestion)
+
+Offsets are committed at checkpoint boundaries (`snapshot_offsets` →
+checkpoint → `commit`), NOT per message: the checkpoint and the
+committed offsets then describe the same instant, and recovery is
+"load checkpoint, replay the tail past its offsets".  Replay is
+at-least-once — the exactly-once guarantee comes from the consumer
+side deduplicating by (worker_id, vector_clock) against the restored
+tracker (runtime/server.ServerNode.process).
+"""
+
+from __future__ import annotations
+
+from kafka_ps_tpu.log.log import LogConfig
+from kafka_ps_tpu.log.manager import LogManager, partition_key
+from kafka_ps_tpu.runtime import serde
+from kafka_ps_tpu.runtime.fabric import (Fabric, GRADIENTS_TOPIC,
+                                         INPUT_DATA_TOPIC, WEIGHTS_TOPIC)
+
+# consuming role per topic (the consumer-group ids on disk)
+GROUP_OF_TOPIC = {
+    GRADIENTS_TOPIC: "server",
+    WEIGHTS_TOPIC: "workers",
+    INPUT_DATA_TOPIC: "ingest",
+}
+
+
+class DurableFabric(Fabric):
+    """Keyed FIFO fabric whose every message is also a durable,
+    offset-addressed log record."""
+
+    durable = True
+
+    def __init__(self, root: str, config: LogConfig | None = None,
+                 tracer=None):
+        super().__init__(tracer)
+        self.manager = LogManager(root, config, tracer=self._tracer)
+        # next undelivered offset per partition; starts at the replay
+        # position set by recover() and advances on every poll
+        self._delivered: dict[tuple[str, int], int] = {}
+        self._recovered = False
+
+    # -- producer side -----------------------------------------------------
+
+    def send(self, topic: str, key: int, message) -> None:
+        offset = self.manager.get(topic, key).append(
+            serde.to_bytes(message))
+        self._tracer.count(f"send.{topic}")
+        with self._cond:
+            self._q(topic, key).append((offset, message))
+            self._cond.notify_all()
+
+    def persist(self, topic: str, key: int, message) -> int:
+        """Append to the log WITHOUT enqueueing — for traffic consumed
+        by the caller at send time (the INPUT_DATA hop: the producer
+        sinks the row straight into a buffer).  The caller marks the
+        offset consumed with `mark_consumed` once the row is applied."""
+        offset = self.manager.get(topic, key).append(
+            serde.to_bytes(message))
+        self._tracer.count(f"send.{topic}")
+        return offset
+
+    def mark_consumed(self, topic: str, key: int, offset: int) -> None:
+        with self._cond:
+            self._delivered[(topic, key)] = offset + 1
+
+    # -- consumer side -----------------------------------------------------
+
+    def poll(self, topic: str, key: int = 0):
+        with self._cond:
+            q = self._q(topic, key)
+            if not q:
+                return None
+            offset, msg = q.popleft()
+            self._delivered[(topic, key)] = offset + 1
+            return msg
+
+    def poll_blocking(self, topic: str, key: int = 0,
+                      timeout: float | None = None):
+        with self._cond:
+            q = self._q(topic, key)
+            if not q:
+                self._cond.wait_for(lambda: bool(q), timeout=timeout)
+            if not q:
+                return None
+            offset, msg = q.popleft()
+            self._delivered[(topic, key)] = offset + 1
+            return msg
+
+    def purge(self, topic: str, key: int, pred) -> int:
+        return super().purge(topic, key, lambda e: pred(e[1]))
+
+    def contains(self, topic: str, key: int, pred) -> bool:
+        return super().contains(topic, key, lambda e: pred(e[1]))
+
+    # -- offsets / recovery ------------------------------------------------
+
+    def snapshot_offsets(self) -> dict[str, int]:
+        """{"topic/key": next undelivered offset} — the instant a
+        checkpoint covers.  Taken under the fabric lock so it is
+        consistent with the queues."""
+        with self._cond:
+            return {partition_key(t, k): off
+                    for (t, k), off in sorted(self._delivered.items())}
+
+    def commit(self, offsets: dict[str, int] | None = None) -> None:
+        """Durably commit consumer offsets (defaults to the current
+        snapshot), fsync the logs up to them, and reap fully-consumed
+        segments."""
+        offsets = offsets if offsets is not None else self.snapshot_offsets()
+        self.manager.flush()
+        by_group: dict[str, dict[str, int]] = {}
+        for pk, off in offsets.items():
+            topic = pk.split("/", 1)[0]
+            group = GROUP_OF_TOPIC.get(topic, topic)
+            by_group.setdefault(group, {})[pk] = off
+        for group, offs in by_group.items():
+            self.manager.commit(group, offs)
+
+    def start_offset(self, topic: str, key: int,
+                     checkpoint_offsets: dict[str, int] | None) -> int:
+        """Where replay starts for a partition: the checkpoint's
+        recorded offset when one is given (authoritative — it matches
+        the restored server/worker state), else the group's durably
+        committed offset, else 0 (full replay)."""
+        pk = partition_key(topic, key)
+        if checkpoint_offsets is not None and pk in checkpoint_offsets:
+            return int(checkpoint_offsets[pk])
+        return self.manager.committed(GROUP_OF_TOPIC.get(topic, topic),
+                                      topic, key)
+
+    def replay(self, topic: str, key: int,
+               checkpoint_offsets: dict[str, int] | None = None):
+        """Yield (offset, message) for the unconsumed tail of a
+        partition (decoded through serde.from_bytes)."""
+        start = self.start_offset(topic, key, checkpoint_offsets)
+        for offset, payload in self.manager.get(topic, key).read_from(start):
+            yield offset, serde.from_bytes(payload)
+
+    def recover(self, checkpoint_offsets: dict[str, int] | None = None
+                ) -> dict[str, int]:
+        """Re-enqueue the unconsumed WEIGHTS / GRADIENTS tail into the
+        in-memory queues (crash recovery: a restarted process sees
+        exactly the in-flight messages the dead one had).  INPUT_DATA
+        is not enqueued — the app replays it into buffers itself
+        (runtime/app.StreamingPSApp.recover_durable).  Returns replay
+        counts per topic."""
+        if self._recovered:
+            raise RuntimeError("recover() must run once, before the "
+                               "drive loop")
+        self._recovered = True
+        counts = {WEIGHTS_TOPIC: 0, GRADIENTS_TOPIC: 0}
+        with self._cond:
+            for topic, key in self.manager.partitions():
+                start = self.start_offset(topic, key, checkpoint_offsets)
+                self._delivered[(topic, key)] = start
+                if topic == INPUT_DATA_TOPIC:
+                    continue
+                q = self._q(topic, key)
+                for offset, payload in \
+                        self.manager.get(topic, key).read_from(start):
+                    q.append((offset, serde.from_bytes(payload)))
+                    counts[topic] = counts.get(topic, 0) + 1
+                    self._tracer.count(f"log.replays.{topic}")
+            self._cond.notify_all()
+        return counts
+
+    def close(self) -> None:
+        self.manager.close()

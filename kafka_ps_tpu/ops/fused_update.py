@@ -40,9 +40,10 @@ shapes near the VMEM boundary.  The default path stays XLA
 A second kernel, `mlp_local_update`, fuses the one-hidden-layer MLP
 family's k-step solver the same way (forward + hand-derived backward
 as one pallas_call, weights as the fori_loop carry — see the section
-comment below); on the bench chip it measures ~1.1-1.2x the XLA path
-at B=1024 F=1024 H=128 (BENCH_r05 `pallas_ab_mlp`).  `--pallas`
-dispatches by task family (runtime/worker._solver_fns).
+comment below); on the bench chip it measures parity with the XLA
+path at B=1024 F=1024 H=128 — recorded speedup 1.008, within trial
+variance (BENCH_r05 `pallas_ab_mlp`).  `--pallas` dispatches by task
+family (runtime/worker._solver_fns).
 """
 
 from __future__ import annotations

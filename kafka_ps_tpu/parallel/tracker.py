@@ -51,6 +51,16 @@ class MessageTracker:
     def received_message(self, worker: int, vector_clock: int) -> None:
         self.tracker[worker].received_message(vector_clock)
 
+    def is_duplicate(self, worker: int, vector_clock: int) -> bool:
+        """True iff a gradient stamped (worker, vector_clock) was
+        already counted: the worker's clock only advances when its
+        gradient for the current clock is applied, so any message below
+        it is a redelivery.  This is the exactly-once filter for the
+        durable log's at-least-once replay (log/durable_fabric.py) —
+        clocks AHEAD of the tracker still raise in received_message,
+        preserving the protocol sanitizer."""
+        return vector_clock < self.tracker[worker].vector_clock
+
     def sent_message(self, worker: int, vector_clock: int) -> None:
         self.tracker[worker].sent_message(vector_clock)
 

@@ -47,10 +47,13 @@ class StreamingPSApp:
                  server_log: LogSink | None = None,
                  worker_log: LogSink | None = None,
                  clock_ms=None,
-                 tracer=None):
+                 tracer=None,
+                 fabric=None):
         self.tracer = tracer or NULL_TRACER
         self.cfg = cfg
-        self.fabric = fabric_mod.Fabric(tracer=self.tracer)
+        # callers may supply a durable fabric (log/durable_fabric.py,
+        # `--durable-log`); default stays the volatile in-memory one
+        self.fabric = fabric or fabric_mod.Fabric(tracer=self.tracer)
         self.buffers = [
             SlidingBuffer(cfg.model.num_features, cfg.buffer, clock_ms=clock_ms)
             for _ in range(cfg.num_workers)]
@@ -73,6 +76,11 @@ class StreamingPSApp:
         # MLP-4096) even when the XLA compile cache hits
         self._fused_programs: dict = {}
         self._reroute_counter = 0
+        # durable resume: leading stream rows to drop because the log
+        # already holds them (the CSV producer deterministically
+        # re-produces the identical global row order, so "skip the
+        # first N" is exactly-once re-ingestion; set by recover_durable)
+        self._ingest_skip = 0
         self.worker_failures: list[tuple[int, BaseException | str]] = []
         # Multi-host: the subset of logical workers this process hosts
         # (None = all).  Every host streams the same CSV with the same
@@ -84,6 +92,13 @@ class StreamingPSApp:
 
     def data_sink(self, worker: int, features: dict[int, float],
                   label: int) -> None:
+        if self._ingest_skip > 0:
+            # durable resume: this row is already in the log (and, via
+            # checkpoint + replay, in a buffer) — drop the re-produced
+            # copy instead of ingesting it twice
+            self._ingest_skip -= 1
+            self.tracer.count("data.replay_skipped_rows")
+            return
         status = self.server.tracker.tracker[worker]
         if not status.active:
             # partition reassignment: rows destined for an evicted worker
@@ -98,6 +113,17 @@ class StreamingPSApp:
             self.tracer.count("data.rerouted_rows")
         if self.local_workers is not None and worker not in self.local_workers:
             return                  # another host's partition
+        if getattr(self.fabric, "durable", False):
+            # the INPUT_DATA hop: log the row under its FINAL key (post
+            # reroute) and mark it consumed immediately — it is applied
+            # to the buffer on the next line, so the ingest group's
+            # offset is the count of buffered rows
+            from kafka_ps_tpu.runtime.messages import LabeledData
+            offset = self.fabric.persist(
+                fabric_mod.INPUT_DATA_TOPIC, worker,
+                LabeledData(features=features, label=label))
+            self.fabric.mark_consumed(
+                fabric_mod.INPUT_DATA_TOPIC, worker, offset)
         self.buffers[worker].add(features, label)
 
     def make_producer(self, csv_path: str, has_header: bool = True,
@@ -119,6 +145,56 @@ class StreamingPSApp:
             if time.monotonic() > deadline:
                 raise TimeoutError("buffers not prefilled in time")
             time.sleep(0.01)
+
+    def wait_for_stream_settle(self, producer,
+                               timeout: float = 120.0) -> None:
+        """Wait until the producer's unthrottled prefill burst is done
+        (prefill rows sent, stream ended, or producer stopped) before
+        training starts.  Training mid-burst races each iteration's
+        buffer snapshot against the tail of the burst, making early
+        windows timing-dependent — the reference avoided the same race
+        with a blanket 20 s sleep (ServerAppRunner.java:95).  A paced
+        stream slower than `timeout` just starts training (live tail
+        ingestion is the steady state, only the burst is waited out)."""
+        prefill = self.cfg.num_workers * self.cfg.stream.prefill_per_worker
+        deadline = time.monotonic() + timeout
+        while (producer.rows_sent < prefill
+               and not producer.finished.is_set()
+               and not producer.stopped.is_set()):
+            if time.monotonic() > deadline:
+                return
+            time.sleep(0.005)
+
+    # -- durable-log recovery (log/durable_fabric.py) ----------------------
+
+    def recover_durable(self) -> dict[str, int]:
+        """Crash recovery over a durable fabric, run once AFTER the
+        checkpoint restore and BEFORE the producer starts:
+
+          * re-enqueue the unconsumed WEIGHTS / GRADIENTS tail (the
+            in-flight messages the dead process held);
+          * replay the unconsumed INPUT_DATA tail into the restored
+            buffers (rows ingested after the last checkpoint);
+          * arm the re-ingestion skip so the restarted producer drops
+            the rows the log already holds.
+
+        The replay floor is the checkpoint's recorded offsets when the
+        restore found any (`server.restored_log_offsets`), else the
+        durably committed ones.  Returns replay counts per topic."""
+        ckpt_offsets = self.server.restored_log_offsets
+        counts = self.fabric.recover(ckpt_offsets)
+        replayed_rows = 0
+        total_logged = 0
+        for topic, key in self.fabric.manager.partitions(
+                fabric_mod.INPUT_DATA_TOPIC):
+            total_logged += self.fabric.manager.get(topic, key).next_offset
+            for offset, row in self.fabric.replay(topic, key, ckpt_offsets):
+                self.buffers[key].add(row.features, row.label)
+                self.fabric.mark_consumed(topic, key, offset)
+                replayed_rows += 1
+        self._ingest_skip = total_logged
+        counts[fabric_mod.INPUT_DATA_TOPIC] = replayed_rows
+        return counts
 
     # -- membership --------------------------------------------------------
 

@@ -91,6 +91,9 @@ class ServerNode:
         # (durable training window); split mode leaves this None — each
         # worker process persists its own state file instead
         self.checkpoint_buffers = None
+        # durable-log recovery (log/durable_fabric.py): the committed
+        # offsets the restored checkpoint covers — replay starts there
+        self.restored_log_offsets: dict[str, int] | None = None
         # logical-run identity: survives checkpoint resumes (restore
         # overwrites it), changes on every fresh start — worker-local
         # state files are only valid within the run that wrote them
@@ -124,7 +127,17 @@ class ServerNode:
             return
         self._loop_started = True
         for worker, status in enumerate(self.tracker.tracker):
-            if status.active and status.weights_message_sent:
+            if not status.active:
+                continue
+            # Durable-log restart: the crash did NOT kill in-flight
+            # messages — the replayed queue may already hold this
+            # worker's reply (log/durable_fabric.recover).  Re-sending
+            # it would double-deliver; the replayed copy is the send.
+            if self.fabric.pending(fabric_mod.WEIGHTS_TOPIC, worker):
+                if not status.weights_message_sent:
+                    self.tracker.sent_message(worker, status.vector_clock)
+                continue
+            if status.weights_message_sent:
                 self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
                                  self._weights_message(status.vector_clock))
                 self.weights_sent_at[worker] = time.monotonic()
@@ -227,6 +240,15 @@ class ServerNode:
             # rather than corrupt the vector-clock protocol
             self.tracer.count("server.zombie_gradients_dropped")
             return
+        if self.tracker.is_duplicate(msg.worker_id, msg.vector_clock):
+            # exactly-once under the durable log's at-least-once replay
+            # (log/durable_fabric.py): a delta whose clock the tracker
+            # already advanced past was applied before the crash (or is
+            # a recomputation from a replayed weights message) — drop
+            # it instead of double-stepping theta.  Clocks AHEAD of the
+            # tracker still raise below (the protocol sanitizer).
+            self.tracer.count("server.duplicate_gradients_dropped")
+            return
         self.tracker.received_message(msg.worker_id, msg.vector_clock)
         self.tracer.count("server.gradients_applied")
 
@@ -285,7 +307,24 @@ class ServerNode:
             return
         if (self.iterations - self._last_checkpoint_iteration
                 >= self.checkpoint_every):
-            from kafka_ps_tpu.utils import checkpoint as ckpt
-            ckpt.save(self.checkpoint_path, self,
-                      buffers=self.checkpoint_buffers)
-            self._last_checkpoint_iteration = self.iterations
+            self.save_checkpoint_now()
+
+    def save_checkpoint_now(self) -> None:
+        """Write the checkpoint, and on a durable fabric
+        (log/durable_fabric.py) make it a COMMIT POINT: snapshot the
+        consumer offsets the state covers, store them inside the
+        checkpoint (authoritative for replay), then durably commit them
+        so retention can reap fully-consumed segments.  Order matters —
+        offsets are only committed once the checkpoint that covers them
+        is on disk, so a crash between the two steps replays extra
+        records (at-least-once) instead of losing them."""
+        if not self.checkpoint_path:
+            return
+        from kafka_ps_tpu.utils import checkpoint as ckpt
+        offsets = (self.fabric.snapshot_offsets()
+                   if getattr(self.fabric, "durable", False) else None)
+        ckpt.save(self.checkpoint_path, self,
+                  buffers=self.checkpoint_buffers, log_offsets=offsets)
+        if offsets is not None:
+            self.fabric.commit(offsets)
+        self._last_checkpoint_iteration = self.iterations

@@ -23,6 +23,7 @@ insertion IDs + arrival-rate window alongside the weights:
 
 from __future__ import annotations
 
+import json
 import os
 
 import numpy as np
@@ -62,7 +63,7 @@ def _atomic_savez(path: str, arrays: dict) -> None:
     os.replace(tmp, path)
 
 
-def save(path: str, server, buffers=None) -> None:
+def save(path: str, server, buffers=None, log_offsets=None) -> None:
     arrays = dict(
         theta=server.theta,
         clocks=np.asarray(server.tracker.clocks, dtype=np.int64),
@@ -72,6 +73,11 @@ def save(path: str, server, buffers=None) -> None:
                           dtype=bool),
         iterations=np.asarray(server.iterations, dtype=np.int64),
         run_id=np.asarray(server.run_id, dtype=np.int64))
+    if log_offsets is not None:
+        # durable-log runs: the consumer offsets this snapshot covers
+        # ("topic/key" -> next offset) — recovery replays the tail past
+        # exactly these (log/durable_fabric.recover)
+        arrays["log_offsets"] = np.asarray(json.dumps(log_offsets))
     _pack_buffers(arrays, buffers)
     _atomic_savez(path, arrays)
 
@@ -97,6 +103,10 @@ def restore(path: str, server, buffers=None) -> None:
         server.iterations = int(z["iterations"])
         if "run_id" in z.files:      # pre-run-id checkpoints: keep ours
             server.run_id = int(z["run_id"])
+        if "log_offsets" in z.files:
+            server.restored_log_offsets = {
+                k: int(v) for k, v
+                in json.loads(str(z["log_offsets"])).items()}
         _unpack_buffers(z, buffers)
     # the crash killed every in-flight message; start_training_loop
     # re-SENDS each worker's current clock (at-least-once redelivery,

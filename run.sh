@@ -4,6 +4,9 @@
 # broker; here one process hosts the whole system on the TPU.
 set -e
 
+# fail fast on syntax errors anywhere in the package before launching
+python -m compileall -q kafka_ps_tpu
+
 if [ ! -f ./data/train.csv ]; then
   echo "generating synthetic fine-food-shaped dataset into ./data"
   python -m kafka_ps_tpu.data.synth --out_dir ./data --rows 20000

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Tier-1 verify: the exact command the driver runs (ROADMAP.md).
+# Passes iff the suite exits 0 within the timeout; DOTS_PASSED echoes
+# the progress-dot count so regressions against the recorded floor are
+# visible at a glance.
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

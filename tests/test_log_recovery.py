@@ -1,0 +1,301 @@
+"""Crash recovery over the durable commit log (kafka_ps_tpu/log/):
+restart = restore checkpoint + replay the unconsumed tail, with
+exactly-once delta application via the tracker's vector clocks.
+
+Process-granularity coverage: the component tests below restart the
+SERVER (fresh ServerNode + fabric over the surviving log) and a WORKER
+(unconsumed weights survive and are not double-sent); the @slow
+subprocess test SIGKILLs the whole in-process job (`cli/run.py
+--durable-log` hosts server + workers together; the socket split mode
+gates the flag out and keeps its own state-file story,
+tests/test_durability.py)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.log import DurableFabric, LogConfig
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime.app import StreamingPSApp
+from kafka_ps_tpu.utils import checkpoint as ckpt
+from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig, PSConfig,
+                                       StreamConfig)
+from kafka_ps_tpu.utils.trace import Tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def small_cfg(num_workers=4):
+    return PSConfig(
+        num_workers=num_workers,
+        consistency_model=0,
+        model=ModelConfig(num_features=8, num_classes=2),
+        buffer=BufferConfig(min_size=8, max_size=32),
+        stream=StreamConfig(time_per_event_ms=1.0),
+    )
+
+
+def make_dataset(n=256, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, size=n).astype(np.int32)
+    centers = np.array([[2.5] * f, [-2.5] * f], np.float32)
+    x = (centers[y] + rng.normal(scale=0.5, size=(n, f))).astype(np.float32)
+    return x, y
+
+
+def build_app(fabric=None, tracer=None):
+    cfg = small_cfg()
+    x, y = make_dataset()
+    app = StreamingPSApp(cfg, test_x=x, test_y=y, tracer=tracer,
+                         fabric=fabric)
+    return app
+
+
+def fill(app, x, y):
+    for i in range(len(x)):
+        app.data_sink(i % app.cfg.num_workers,
+                      {j: float(v) for j, v in enumerate(x[i]) if v != 0},
+                      int(y[i]))
+
+
+def test_server_restart_replays_to_identical_theta(tmp_path):
+    """Run 40 iterations uninterrupted (volatile fabric) vs. 24
+    iterations + simulated crash + recovered restart to 40 (durable
+    fabric): bitwise-identical final theta, and the restart provably
+    dropped redelivered deltas instead of double-applying them."""
+    x, y = make_dataset()
+
+    base = build_app()
+    fill(base, x, y)
+    base.run_serial(max_server_iterations=40)
+    theta_base = np.asarray(base.server.theta)
+
+    log_dir = str(tmp_path / "wal")
+    ck_path = str(tmp_path / "ck.npz")
+    app1 = build_app(fabric=DurableFabric(log_dir, LogConfig(fsync="none")))
+    app1.server.checkpoint_path = ck_path
+    app1.server.checkpoint_every = 16
+    app1.server.checkpoint_buffers = app1.buffers
+    fill(app1, x, y)
+    app1.run_serial(max_server_iterations=24)
+    assert os.path.exists(ck_path)
+    with np.load(ck_path) as z:
+        ck_iters = int(z["iterations"])
+        assert 16 <= ck_iters < 24          # crash loses post-ck progress
+        assert "log_offsets" in z.files     # the commit point's offsets
+    # SIGKILL simulation: app1 is abandoned here — no close(), no final
+    # save; everything past the last commit point lives only in the log
+
+    tracer = Tracer()
+    app2 = build_app(
+        fabric=DurableFabric(log_dir, LogConfig(fsync="none")),
+        tracer=tracer)
+    app2.server.checkpoint_path = ck_path
+    app2.server.checkpoint_every = 16
+    app2.server.checkpoint_buffers = app2.buffers
+    assert ckpt.maybe_restore(ck_path, app2.server, buffers=app2.buffers)
+    assert app2.server.iterations == ck_iters
+    assert app2.server.restored_log_offsets is not None
+    counts = app2.recover_durable()
+    # the tail past the commit point was replayed, not lost
+    assert counts[fabric_mod.GRADIENTS_TOPIC] > 0
+    assert counts[fabric_mod.WEIGHTS_TOPIC] > 0
+    app2.run_serial(max_server_iterations=40)
+
+    np.testing.assert_array_equal(np.asarray(app2.server.theta), theta_base)
+    assert app2.server.tracker.clocks == base.server.tracker.clocks
+    # exactly-once: recomputed gradients for already-applied clocks were
+    # redeliveries and the tracker's clock filter dropped every one
+    assert tracer.counters().get("server.duplicate_gradients_dropped", 0) > 0
+
+
+def test_recovery_without_checkpoint_is_full_replay(tmp_path):
+    """Crash before the first commit point: recovery replays every
+    partition from offset 0 — rows re-enter the buffers from the log,
+    gradients re-apply in order — and converges to the uninterrupted
+    run's exact theta."""
+    x, y = make_dataset()
+    base = build_app()
+    fill(base, x, y)
+    base.run_serial(max_server_iterations=24)
+
+    log_dir = str(tmp_path / "wal")
+    app1 = build_app(fabric=DurableFabric(log_dir, LogConfig(fsync="none")))
+    fill(app1, x, y)
+    app1.run_serial(max_server_iterations=12)
+    # abandoned: no checkpoint was ever configured
+
+    app2 = build_app(fabric=DurableFabric(log_dir, LogConfig(fsync="none")))
+    counts = app2.recover_durable()
+    assert counts[fabric_mod.INPUT_DATA_TOPIC] == len(x)
+    assert [b.count for b in app2.buffers] == [b.count for b in app1.buffers]
+    # the producer-resume skip covers every logged row
+    assert app2._ingest_skip == len(x)
+    app2.run_serial(max_server_iterations=24)
+    np.testing.assert_array_equal(np.asarray(app2.server.theta),
+                                  np.asarray(base.server.theta))
+
+
+def test_worker_restart_unconsumed_weights_survive(tmp_path):
+    """A weights message sent but never consumed (the worker died first)
+    is re-enqueued by recovery, and the restarted server does NOT send a
+    second copy for the same clock (the start_training_loop pending
+    guard) — the worker sees exactly one delivery."""
+    x, y = make_dataset()
+    log_dir = str(tmp_path / "wal")
+    ck_path = str(tmp_path / "ck.npz")
+    app1 = build_app(fabric=DurableFabric(log_dir, LogConfig(fsync="none")))
+    app1.server.checkpoint_path = ck_path
+    fill(app1, x, y)
+    app1.server.start_training_loop()       # bootstrap broadcast logged
+    # worker 0 consumes its copy and replies; workers 1-3 die first
+    m = app1.fabric.poll(fabric_mod.WEIGHTS_TOPIC, 0)
+    app1.workers[0].on_weights(m)
+    app1.server.save_checkpoint_now()       # commit point mid-flight
+
+    app2 = build_app(fabric=DurableFabric(log_dir, LogConfig(fsync="none")))
+    assert ckpt.maybe_restore(ck_path, app2.server, buffers=app2.buffers)
+    app2.recover_durable()
+    # workers 1-3's unconsumed bootstrap copies came back from the log
+    for w in (1, 2, 3):
+        assert app2.fabric.pending(fabric_mod.WEIGHTS_TOPIC, w) == 1
+    app2.server.start_training_loop()
+    for w in (1, 2, 3):
+        assert app2.fabric.pending(fabric_mod.WEIGHTS_TOPIC, w) == 1, \
+            "pending guard failed: bootstrap re-sent on top of the replay"
+    # and each replayed message is deliverable exactly once
+    got = app2.fabric.poll(fabric_mod.WEIGHTS_TOPIC, 1)
+    assert got is not None and got.vector_clock == 0
+    assert app2.fabric.poll(fabric_mod.WEIGHTS_TOPIC, 1) is None
+
+
+def test_corrupted_tail_is_discarded_and_regenerated(tmp_path):
+    """Garbage bytes on the gradients log tail (a torn write the crash
+    left behind): recovery truncates them via CRC, the lost deltas are
+    recomputed from the replayed weights, and the run still converges to
+    the uninterrupted baseline — no crash loop, no divergence."""
+    x, y = make_dataset()
+    base = build_app()
+    fill(base, x, y)
+    base.run_serial(max_server_iterations=40)
+
+    log_dir = str(tmp_path / "wal")
+    ck_path = str(tmp_path / "ck.npz")
+    app1 = build_app(fabric=DurableFabric(log_dir, LogConfig(fsync="none")))
+    app1.server.checkpoint_path = ck_path
+    app1.server.checkpoint_every = 16
+    app1.server.checkpoint_buffers = app1.buffers
+    fill(app1, x, y)
+    app1.run_serial(max_server_iterations=24)
+
+    # corrupt the tail of the gradients partition's active segment
+    grad_log = app1.fabric.manager.get(fabric_mod.GRADIENTS_TOPIC, 0)
+    with open(grad_log.active.log_path, "r+b") as fh:
+        fh.seek(-11, os.SEEK_END)
+        fh.write(b"\xde\xad\xbe\xef garbage")
+
+    tracer = Tracer()
+    fabric2 = DurableFabric(log_dir, LogConfig(fsync="none"),
+                            tracer=tracer)
+    assert fabric2.manager.truncated_bytes > 0
+    app2 = build_app(fabric=fabric2, tracer=tracer)
+    app2.server.checkpoint_path = ck_path
+    app2.server.checkpoint_buffers = app2.buffers
+    assert ckpt.maybe_restore(ck_path, app2.server, buffers=app2.buffers)
+    app2.recover_durable()
+    app2.run_serial(max_server_iterations=40)
+    np.testing.assert_array_equal(np.asarray(app2.server.theta),
+                                  np.asarray(base.server.theta))
+
+
+def test_recover_is_once_only(tmp_path):
+    f = DurableFabric(str(tmp_path / "wal"), LogConfig(fsync="none"))
+    f.recover()
+    with pytest.raises(RuntimeError, match="once"):
+        f.recover()
+    f.close()
+
+
+# -- whole-process SIGKILL through the CLI -----------------------------------
+
+def _env() -> dict:
+    env = dict(os.environ)
+    env["KPS_PLATFORM"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+@pytest.mark.slow
+def test_sigkill_restart_matches_uninterrupted_run(tmp_path):
+    """SIGKILL the in-process job mid-run; restart with the same
+    --durable-log and --checkpoint: it must replay from the committed
+    offsets and finish with the exact final theta and clocks of an
+    uninterrupted run.  The dataset (512 rows = 4 workers x 128 prefill)
+    prefills entirely before training, so serial mode is bitwise
+    deterministic."""
+    from kafka_ps_tpu.data.synth import generate, write_csv
+    x, y = generate(632, 16, 3, noise=1.0, sparsity=0.5, seed=0)
+    write_csv(str(tmp_path / "train.csv"), x[:512], y[:512])
+    write_csv(str(tmp_path / "test.csv"), x[512:], y[512:])
+    for d in ("base", "crash"):
+        (tmp_path / d).mkdir()
+
+    def cmd(ck, extra):
+        return [sys.executable, "-m", "kafka_ps_tpu.cli.run",
+                "-training", "../train.csv", "-test", "../test.csv",
+                "--num_features", "16", "--num_classes", "3",
+                "--num_workers", "4", "--mode", "serial", "-p", "2",
+                "--eval_every", "10", "--max_iterations", "160",
+                "--checkpoint", ck, "--checkpoint_every", "20",
+                "-v"] + extra
+
+    # uninterrupted baseline (volatile fabric: the flagless path must
+    # behave identically, acceptance criterion)
+    r = subprocess.run(cmd("ck.npz", []), cwd=tmp_path / "base",
+                       env=_env(), capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stderr[-3000:]
+    with np.load(tmp_path / "base" / "ck.npz") as z:
+        theta_base = z["theta"].copy()
+        clocks_base = z["clocks"].copy()
+        assert int(z["iterations"]) >= 160
+
+    # durable run, killed once the first commit point exists
+    durable = ["--durable-log", "wal", "--fsync", "interval"]
+    ck = tmp_path / "crash" / "ck.npz"
+    proc = subprocess.Popen(cmd("ck.npz", durable), cwd=tmp_path / "crash",
+                            env=_env(), stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    deadline = time.monotonic() + 240.0
+    while not ck.exists() and time.monotonic() < deadline:
+        if proc.poll() is not None:
+            out, err = proc.communicate()
+            pytest.fail(f"job exited before first checkpoint: {err[-3000:]}")
+        time.sleep(0.02)
+    assert ck.exists(), "no checkpoint appeared in time"
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+    with np.load(ck) as z:
+        crash_iters = int(z["iterations"])
+    assert crash_iters < 160, "job finished before the kill — no crash to test"
+
+    # restart: restore + replay + run to completion
+    r2 = subprocess.run(cmd("ck.npz", durable), cwd=tmp_path / "crash",
+                        env=_env(), capture_output=True, text=True,
+                        timeout=300)
+    assert r2.returncode == 0, r2.stderr[-3000:]
+    assert f"restored checkpoint at iteration {crash_iters}" in r2.stdout, \
+        r2.stdout[-2000:]
+    assert "durable-log replay" in r2.stdout, r2.stdout[-2000:]
+
+    with np.load(ck) as z:
+        assert int(z["iterations"]) >= 160
+        np.testing.assert_array_equal(z["clocks"], clocks_base)
+        np.testing.assert_array_equal(z["theta"], theta_base)
