@@ -104,6 +104,12 @@ def build_parser(include_server_flags: bool = True,
                         "worker iterations — logreg and mlp families "
                         "(ops/fused_update.py; auto-falls-back off-TPU "
                         "or past the VMEM budget)")
+    p.add_argument("--no-gang", action="store_true", dest="no_gang",
+                   help="disable gang-scheduled dispatch: process every "
+                        "gate release as its own device step instead of "
+                        "coalescing simultaneous releases into one "
+                        "batched step (runtime/gang.py, "
+                        "docs/GANG_DISPATCH.md)")
     p.add_argument("--failure_policy", choices=["halt", "rebalance"],
                    default="halt",
                    help="threaded mode: evict crashed/hung workers and "
@@ -173,6 +179,7 @@ def make_app_from_args(args, resuming: bool = False,
         stream=StreamConfig(time_per_event_ms=args.producer_time_per_event),
         use_pallas=args.pallas,
         eval_every=getattr(args, "eval_every", 1),
+        use_gang=not getattr(args, "no_gang", False),
     )
     test_x, test_y = load_test_csv(args.test_data_file_path,
                                    args.num_features)

@@ -199,13 +199,28 @@ def _validate_elastic_epochs(worker_df: pd.DataFrame,
         key = (int(row["partition"]), epoch)
         last_row_ts[key] = max(last_row_ts.get(key, rts), rts)
 
+    # workers already warned about per (worker, epoch): one exemption
+    # warning per blind spot, not one per spread check
+    warned_truncated: set[tuple[int, int]] = set()
+
     def spread_workers(ts: int) -> dict[int, int]:
         nxt = next((r for r in resume_ts if r > ts), None)
         if nxt is None:
             return latest
         epoch = sum(1 for r in resume_ts if r <= ts)
-        return {w: c for w, c in latest.items()
+        kept = {w: c for w, c in latest.items()
                 if last_row_ts.get((w, epoch), -1) >= ts}
+        for w in latest:
+            if w not in kept and (w, epoch) not in warned_truncated:
+                warned_truncated.add((w, epoch))
+                warnings.warn(
+                    f"staleness audit: worker {w} exempted from the "
+                    f"spread check from timestamp {ts} to the end of "
+                    f"crash epoch {epoch} (its log went silent before "
+                    "the crash — rows lost to the truncated deferred "
+                    "sink and a genuine stall are indistinguishable, "
+                    "so its clock no longer constrains the spread)")
+        return kept
 
     def spread_check(ts: int) -> None:
         clocks = spread_workers(ts)

@@ -64,6 +64,17 @@ class DurableFabric(Fabric):
             self._q(topic, key).append((offset, message))
             self._cond.notify_all()
 
+    def send_transient(self, topic: str, key: int, message) -> None:
+        """Enqueue WITHOUT logging: advisory in-process traffic (gang
+        notices) that has no serde frame and must not survive a restart
+        — a replayed notice would promise weights messages whose
+        delivery already happened.  Queued as (None, message); polls
+        skip the offset bookkeeping for such entries."""
+        self._tracer.count(f"send.{topic}")
+        with self._cond:
+            self._q(topic, key).append((None, message))
+            self._cond.notify_all()
+
     def persist(self, topic: str, key: int, message) -> int:
         """Append to the log WITHOUT enqueueing — for traffic consumed
         by the caller at send time (the INPUT_DATA hop: the producer
@@ -86,7 +97,8 @@ class DurableFabric(Fabric):
             if not q:
                 return None
             offset, msg = q.popleft()
-            self._delivered[(topic, key)] = offset + 1
+            if offset is not None:       # transient entries have no offset
+                self._delivered[(topic, key)] = offset + 1
             return msg
 
     def poll_blocking(self, topic: str, key: int = 0,
@@ -98,7 +110,8 @@ class DurableFabric(Fabric):
             if not q:
                 return None
             offset, msg = q.popleft()
-            self._delivered[(topic, key)] = offset + 1
+            if offset is not None:       # transient entries have no offset
+                self._delivered[(topic, key)] = offset + 1
             return msg
 
     def purge(self, topic: str, key: int, pred) -> int:

@@ -336,3 +336,206 @@ def mlp_local_update(theta: jax.Array, x: jax.Array, y: jax.Array,
         w1=dw1[:hidden], b1=db1[0, :hidden],
         w2=dw2[:cfg.num_rows, :hidden], b2=db2[0, :cfg.num_rows]))
     return delta, loss[0, 0]
+
+
+# -- batched (gang) entries: grid over the worker axis -----------------------
+# One pallas_call runs a whole gang release set (runtime/gang.py): the
+# grid's single axis walks the k gang members, each grid instance
+# getting one member's (theta, slab) block via BlockSpecs whose leading
+# `None` dimension squeezes the worker axis away — so the instance body
+# IS the single-worker kernel, unchanged, and produces bit-identical
+# per-member results by construction.  Versus k separate pallas_calls
+# this costs one dispatch instead of k; the per-instance VMEM story is
+# identical (one member's working set at a time), so the same
+# fits_in_vmem gates apply.
+
+
+def _pad_batch_b(xs, ys, masks):
+    """_pad_batch over stacked slabs: pad the BATCH axis (axis 1) of
+    [k, B, ...] inputs to a sublane multiple; padded rows carry mask 0."""
+    pad_b = (-xs.shape[1]) % 8
+    if pad_b:
+        xs = jnp.pad(xs, ((0, 0), (0, pad_b), (0, 0)))
+        ys = jnp.pad(ys, ((0, 0), (0, pad_b)))
+        masks = jnp.pad(masks, ((0, 0), (0, pad_b)))
+    return xs, ys, masks
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "interpret", "allow_fallback"))
+def local_update_batched(thetas: jax.Array, xs: jax.Array, ys: jax.Array,
+                         masks: jax.Array, *, cfg: ModelConfig,
+                         interpret: bool = False,
+                         allow_fallback: bool = True
+                         ) -> tuple[jax.Array, jax.Array]:
+    """k independent logreg local updates as ONE device step:
+    thetas [k, P], xs [k, B, F], ys [k, B], masks [k, B] →
+    (deltas [k, P], losses [k]).  Row i equals
+    local_update(thetas[i], xs[i], ys[i], masks[i]) bitwise — the grid
+    instance runs the identical kernel body on the identical block.
+    Fallback rules match `local_update`, applied per-instance shapes
+    (the grid holds one member's working set in VMEM at a time); the
+    fallback itself is the vmapped XLA path."""
+    k, batch, num_features = xs.shape
+    on_tpu = jax.default_backend() == "tpu"
+    if not (fits_in_vmem(batch, num_features) and (on_tpu or interpret)):
+        if not allow_fallback:
+            raise ValueError(
+                f"pallas local_update_batched unavailable (k={k}, "
+                f"batch={batch}, features={num_features}, "
+                f"backend={jax.default_backend()})")
+        return jax.vmap(
+            lambda t, x, y, m: logreg.local_update(t, x, y, m, cfg=cfg)
+        )(thetas, xs, ys, masks)
+
+    def pack(theta):
+        params = logreg.unflatten(theta, cfg)
+        w0 = jnp.zeros((LANES, num_features), jnp.float32
+                       ).at[:cfg.num_rows].set(params.weights)
+        b0 = jnp.zeros((1, LANES), jnp.float32
+                       ).at[0, :cfg.num_rows].set(params.intercept)
+        return w0, b0
+
+    w0s, b0s = jax.vmap(pack)(thetas)          # [k,LANES,F], [k,1,LANES]
+    xs, ys, masks = _pad_batch_b(xs, ys, masks)
+    batch_p = xs.shape[1]
+
+    kernel = functools.partial(_kernel, k=cfg.num_max_iter,
+                               lr=cfg.local_learning_rate,
+                               num_rows=cfg.num_rows)
+
+    def member(i):                 # BlockSpec: member i's block, worker
+        return (i, 0, 0)           # axis squeezed by the None dimension
+
+    dws, dbs, losses = pl.pallas_call(
+        kernel,
+        grid=(k,),
+        in_specs=[
+            pl.BlockSpec((None, batch_p, num_features), member,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, batch_p, 1), member,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, batch_p, 1), member,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, LANES, num_features), member,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, 1, LANES), member,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((None, LANES, num_features), member,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, 1, LANES), member,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, 1, 1), member,
+                         memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((k, LANES, num_features), jnp.float32),
+            jax.ShapeDtypeStruct((k, 1, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((k, 1, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )(xs.astype(jnp.float32),
+      ys.astype(jnp.int32)[..., None],
+      masks.astype(jnp.float32)[..., None],
+      w0s, b0s)
+
+    deltas = jax.vmap(
+        lambda dw, db: logreg.LogRegParams(
+            weights=dw[:cfg.num_rows],
+            intercept=db[0, :cfg.num_rows]).flat)(dws, dbs)
+    return deltas, losses[:, 0, 0]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "interpret", "allow_fallback"))
+def mlp_local_update_batched(thetas: jax.Array, xs: jax.Array,
+                             ys: jax.Array, masks: jax.Array, *,
+                             cfg: ModelConfig,
+                             interpret: bool = False,
+                             allow_fallback: bool = True
+                             ) -> tuple[jax.Array, jax.Array]:
+    """k independent MLP local updates as ONE device step — the MLP
+    counterpart of `local_update_batched`; row i equals
+    mlp_local_update(thetas[i], ...) bitwise."""
+    from kafka_ps_tpu.models import mlp as mlp_mod
+
+    k, batch, num_features = xs.shape
+    hidden = cfg.hidden_dim
+    on_tpu = jax.default_backend() == "tpu"
+    if not (mlp_fits_in_vmem(batch, num_features, hidden)
+            and (on_tpu or interpret)):
+        if not allow_fallback:
+            raise ValueError(
+                f"pallas mlp_local_update_batched unavailable (k={k}, "
+                f"batch={batch}, features={num_features}, "
+                f"hidden={hidden}, backend={jax.default_backend()})")
+        task = mlp_mod.MLPTask(cfg)
+        return jax.vmap(task.local_update)(thetas, xs, ys, masks)
+
+    h8 = hidden + (-hidden) % LANES
+
+    def pack(theta):
+        params = mlp_mod.unflatten(theta, cfg)
+        w1 = jnp.zeros((h8, num_features), jnp.float32
+                       ).at[:hidden].set(params.w1)
+        b1 = jnp.zeros((1, h8), jnp.float32).at[0, :hidden].set(params.b1)
+        w2 = jnp.zeros((LANES, h8), jnp.float32
+                       ).at[:cfg.num_rows, :hidden].set(params.w2)
+        b2 = jnp.zeros((1, LANES), jnp.float32
+                       ).at[0, :cfg.num_rows].set(params.b2)
+        return w1, b1, w2, b2
+
+    w1s, b1s, w2s, b2s = jax.vmap(pack)(thetas)
+    xs, ys, masks = _pad_batch_b(xs, ys, masks)
+    batch_p = xs.shape[1]
+
+    kernel = functools.partial(_mlp_kernel, k=cfg.num_max_iter,
+                               lr=cfg.local_learning_rate,
+                               num_rows=cfg.num_rows)
+
+    def member(i):
+        return (i, 0, 0)
+
+    def vspec(a, b):
+        return pl.BlockSpec((None, a, b), member, memory_space=pltpu.VMEM)
+
+    dw1s, db1s, dw2s, db2s, losses = pl.pallas_call(
+        kernel,
+        grid=(k,),
+        in_specs=[
+            vspec(batch_p, num_features),
+            vspec(batch_p, 1),
+            vspec(batch_p, 1),
+            vspec(h8, num_features),
+            vspec(1, h8),
+            vspec(LANES, h8),
+            vspec(1, LANES),
+        ],
+        out_specs=(
+            vspec(h8, num_features),
+            vspec(1, h8),
+            vspec(LANES, h8),
+            vspec(1, LANES),
+            pl.BlockSpec((None, 1, 1), member, memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((k, h8, num_features), jnp.float32),
+            jax.ShapeDtypeStruct((k, 1, h8), jnp.float32),
+            jax.ShapeDtypeStruct((k, LANES, h8), jnp.float32),
+            jax.ShapeDtypeStruct((k, 1, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((k, 1, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )(xs.astype(jnp.float32),
+      ys.astype(jnp.int32)[..., None],
+      masks.astype(jnp.float32)[..., None],
+      w1s, b1s, w2s, b2s)
+
+    deltas = jax.vmap(
+        lambda dw1, db1, dw2, db2: mlp_mod.flatten(mlp_mod.MLPParams(
+            w1=dw1[:hidden], b1=db1[0, :hidden],
+            w2=dw2[:cfg.num_rows, :hidden],
+            b2=db2[0, :cfg.num_rows])))(dw1s, db1s, dw2s, db2s)
+    return deltas, losses[:, 0, 0]

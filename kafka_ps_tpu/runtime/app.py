@@ -254,30 +254,69 @@ class StreamingPSApp:
             if close is not None:
                 close()
 
+    def _make_gang(self):
+        """The gang dispatcher for this run, or None when coalescing is
+        off (`--no-gang`) — built lazily so non-gang runs never import
+        runtime/gang.py."""
+        if not self.cfg.use_gang:
+            return None
+        from kafka_ps_tpu.runtime.gang import GangDispatcher
+        return GangDispatcher(self.workers, self.fabric, self.cfg,
+                              tracer=self.tracer)
+
     def run_serial(self, max_server_iterations: int,
                    pump=None, status_every: float | None = None) -> None:
         """Deterministic scheduler: alternate weights delivery / gradient
         processing until the server has applied `max_server_iterations`
         gradient messages.  `pump()` (optional) feeds more stream rows
-        between rounds."""
+        between rounds.
+
+        With gang dispatch on (the default) the schedule drains each
+        release set whole: gang notices are claimed first (one batched
+        worker dispatch per set), then stragglers run per-message, then
+        the queued gradients are drained as one batch for the server's
+        batched apply (runtime/server.process_batch).  `--no-gang` keeps
+        the original strictly per-message alternation."""
         reporter = self._start_status(status_every)
         stalled_rounds = 0
+        gang = self._make_gang()
         try:
             self.server.start_training_loop()
             while self.server.iterations < max_server_iterations:
                 progressed = False
+                if gang is not None and gang.drain_serial():
+                    progressed = True
                 for worker in self.workers:
                     msg = self.fabric.poll(fabric_mod.WEIGHTS_TOPIC,
                                            worker.worker_id)
                     if msg is not None:
                         worker.on_weights(msg)
                         progressed = True
-                while self.server.iterations < max_server_iterations:
-                    g = self.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0)
-                    if g is None:
-                        break
-                    self.server.process(g)
-                    progressed = True
+                if gang is None:
+                    while self.server.iterations < max_server_iterations:
+                        g = self.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0)
+                        if g is None:
+                            break
+                        self.server.process(g)
+                        progressed = True
+                else:
+                    # drain the whole backlog, capped so a full batch
+                    # cannot overshoot the iteration budget (bench runs
+                    # rely on exact counts); drops (zombies/duplicates)
+                    # under-fill a round and the outer loop tops it up
+                    batch = []
+                    while (self.server.iterations + len(batch)
+                           < max_server_iterations):
+                        g = self.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0)
+                        if g is None:
+                            break
+                        batch.append(g)
+                    if len(batch) > 1:
+                        self.server.process_batch(batch)
+                        progressed = True
+                    elif batch:
+                        self.server.process(batch[0])
+                        progressed = True
                 if pump is not None:
                     pump()
                 # pump() can only add buffer rows, never fabric messages,
@@ -318,6 +357,11 @@ class StreamingPSApp:
 
         worker_errors: list[BaseException] = []
         failed_q: deque[tuple[int, BaseException]] = deque()
+        gang = self._make_gang()
+        if gang is not None:
+            from kafka_ps_tpu.runtime.gang import GangMemberError
+        else:
+            GangMemberError = ()     # never raised without a gang
 
         def worker_loop(worker: WorkerNode):
             try:
@@ -326,10 +370,19 @@ class StreamingPSApp:
                         fabric_mod.WEIGHTS_TOPIC, worker.worker_id,
                         timeout=poll_timeout)
                     if msg is not None:
-                        worker.on_weights(msg)
+                        if gang is not None:
+                            # first arrival covered by a gang notice
+                            # leads the set; otherwise runs solo
+                            gang.offer(worker, msg)
+                        else:
+                            worker.on_weights(msg)
             except BaseException as e:   # surface worker death to the server
+                # a gang member's failure surfaces on the LEADER's thread;
+                # attribute it to the member, not the messenger
+                wid = (e.worker_id if isinstance(e, GangMemberError)
+                       else worker.worker_id)
                 if failure_policy == "rebalance":
-                    failed_q.append((worker.worker_id, e))
+                    failed_q.append((wid, e))
                 else:
                     worker_errors.append(e)
                     self._stop.set()
@@ -398,7 +451,25 @@ class StreamingPSApp:
                 g = self.fabric.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0,
                                               timeout=poll_timeout)
                 if g is not None:
-                    self.server.process(g)
+                    if gang is None:
+                        self.server.process(g)
+                    else:
+                        # piggyback whatever else is already queued onto
+                        # this wake-up: one batched apply instead of one
+                        # apply per gradient (no waiting — only messages
+                        # that have ALREADY arrived join the batch)
+                        batch = [g]
+                        while (self.server.iterations + len(batch)
+                               < max_server_iterations):
+                            g2 = self.fabric.poll(
+                                fabric_mod.GRADIENTS_TOPIC, 0)
+                            if g2 is None:
+                                break
+                            batch.append(g2)
+                        if len(batch) > 1:
+                            self.server.process_batch(batch)
+                        else:
+                            self.server.process(g)
                 if failure_policy == "rebalance":
                     supervise()
         finally:
@@ -645,6 +716,15 @@ class StreamingPSApp:
                 # rounds with that round's mean local loss.  Each
                 # process logs only the workers it hosts (its sink path
                 # is process-suffixed in multi-host mode, cli/run.py).
+                # Log-schema caveat: numTuplesSeen is CHUNK-granular
+                # here, not round-granular — all r rows of a chunk stamp
+                # the buffer version sampled after the chunk dispatch,
+                # because the per-round values no longer exist (the
+                # rounds ran fused on device against one slab snapshot).
+                # The per-node path stamps it per iteration; consumers
+                # correlating loss against data volume should treat the
+                # fused path's column as a step function with CHUNK-wide
+                # treads.
                 for i in range(r):
                     ci = clock - r + 1 + i
                     round_loss = (losses[i] if losses is not None

@@ -27,6 +27,10 @@ from kafka_ps_tpu.utils.trace import NULL_TRACER, Tracer
 WEIGHTS_TOPIC = "weights"
 GRADIENTS_TOPIC = "gradients"
 INPUT_DATA_TOPIC = "input-data"
+# Advisory gang-release notices (runtime/gang.py): in-process control
+# traffic with no reference-topic analogue — never serialized, never
+# durable (a lost notice only costs a coalescing opportunity).
+GANG_TOPIC = "gang"
 
 
 class Fabric:
@@ -49,6 +53,12 @@ class Fabric:
         with self._cond:
             self._q(topic, key).append(message)
             self._cond.notify_all()
+
+    def send_transient(self, topic: str, key: int, message: Any) -> None:
+        """Enqueue WITHOUT durability semantics — advisory in-process
+        traffic (GANG_TOPIC notices) that subclasses must not log or
+        serialize.  Identical to `send` on the volatile fabric."""
+        self.send(topic, key, message)
 
     def poll(self, topic: str, key: int = 0) -> Any | None:
         """Non-blocking: next message for (topic, key) or None."""

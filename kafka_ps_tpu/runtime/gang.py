@@ -1,0 +1,383 @@
+"""Gang-scheduled dispatch — coalesce simultaneous gate releases into
+one batched XLA step on the per-node path (docs/GANG_DISPATCH.md).
+
+The consistency gate routinely releases several workers at the same
+moment: ALL of them under sequential (BSP), a subset under bounded
+delay whenever the slowest worker catches up, every active worker at
+bootstrap.  The per-message path pays one `update_and_eval` dispatch
+per released worker; over a tunneled transport each dispatch is a host
+round-trip, which is what bounds the measured per-node rate (BENCH_r05
+148.5 iters/s at eval cadence 1).  This is the classic parameter-server
+batching lever (Li et al., OSDI'14); under bounded staleness the sets
+that coalesce are exactly the SSP release sets of Ho et al. (NIPS'13).
+
+A `GangDispatcher` claims a release set (advertised by the server's
+advisory `GangNotice` on GANG_TOPIC alongside the per-worker messages),
+runs `_prepare` on every member (each keeps its private buffer slab and
+`num_tuples_seen` version), stacks the member slabs, and runs ONE
+vmapped solver dispatch over the (k, …) batch — theta broadcasts when
+the set shares one weights array (sequential consistency: the server
+aliases the same device theta into every member's message), stacks
+otherwise (bounded/eventual sets with differing clocks).  The k deltas
+and metric futures are unstacked INSIDE the jit (one dispatch, k
+buffers out), then `_finish` runs per member in worker-id order — the
+same per-worker CSV rows and the same per-worker GradientMessages, in
+the same order, as the per-message path.  Bitwise equivalence with the
+per-message path is a tested invariant (tests/test_gang.py), not an
+approximation: vmap runs the identical per-element program.
+
+Threaded mode coalesces by first arrival: the thread that pops a
+weights message covered by a notice becomes the gang leader and polls
+the fabric for siblings already enqueued — no timer sleeps on the hot
+path.  Members whose threads beat the leader to their own messages
+simply run solo there; a gang is an optimization, never a barrier.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime import worker as worker_mod
+from kafka_ps_tpu.utils.trace import NULL_TRACER
+
+
+class GangMemberError(RuntimeError):
+    """A gang member failed inside another worker's thread — carries the
+    member's id so the threaded supervisor evicts the right worker."""
+
+    def __init__(self, worker_id: int, cause: BaseException):
+        super().__init__(f"gang member {worker_id} failed: {cause!r}")
+        self.worker_id = worker_id
+
+
+@functools.lru_cache(maxsize=None)
+def _gang_solver_fns(task_name: str, cfg, use_pallas: bool,
+                     grid: bool = True):
+    """Batched counterparts of worker._solver_fns, one compile per
+    (task, cfg, member-count) — four jit'd entry points over TUPLES of
+    per-member arrays (stacked inside the jit, so stacking costs no
+    extra dispatch; unstacked inside the jit, so fan-out costs none
+    either):
+
+      update_stacked(thetas, xs, ys, masks)
+      update_bcast(theta, xs, ys, masks)            # shared theta
+      update_eval_stacked(thetas, xs, ys, masks, test_x, test_y)
+      update_eval_bcast(theta, xs, ys, masks, test_x, test_y)
+
+    The non-pallas variants vmap the SAME composite function the
+    single-dispatch path jits (vmap preserves per-element semantics —
+    the bitwise-equivalence test in tests/test_gang.py is the
+    contract).  With use_pallas the solver goes through the batched
+    grid kernels (ops/fused_update.*_batched, grid over the worker
+    axis); `grid=False` selects the vmap-of-kernel fallback for
+    backends where the grid variant is unsupported."""
+    import jax
+    import jax.numpy as jnp
+
+    from kafka_ps_tpu.models.task import get_task
+    task = get_task(task_name, cfg)
+
+    if use_pallas:
+        from kafka_ps_tpu.ops import fused_update
+        single = {"logreg": fused_update.local_update,
+                  "mlp": fused_update.mlp_local_update}[task_name]
+        if grid:
+            batched = {"logreg": fused_update.local_update_batched,
+                       "mlp": fused_update.mlp_local_update_batched
+                       }[task_name]
+
+            def solver_b(thetas, xs, ys, masks):
+                return batched(thetas, xs, ys, masks, cfg=cfg)
+        else:
+            solver_b = jax.vmap(
+                lambda t, x, y, m: single(t, x, y, m, cfg=cfg))
+
+        def solver_1(theta, x, y, mask):
+            return single(theta, x, y, mask, cfg=cfg)
+    else:
+        solver_1 = task.local_update
+        solver_b = jax.vmap(solver_1)
+
+    # the exact composite the single path jits (worker._solver_fns):
+    # k-step solver + full-test-set eval of theta+delta, one program
+    def composite(theta, x, y, mask, test_x, test_y):
+        delta, loss = solver_1(theta, x, y, mask)
+        m = task.evaluate(theta + delta, test_x, test_y)
+        return delta, loss, m.f1, m.accuracy
+
+    def unstack(a, k):
+        return tuple(a[i] for i in range(k))
+
+    @jax.jit
+    def update_stacked(thetas, xs, ys, masks):
+        k = len(xs)
+        deltas, losses = solver_b(jnp.stack(thetas), jnp.stack(xs),
+                                  jnp.stack(ys), jnp.stack(masks))
+        return unstack(deltas, k), unstack(losses, k)
+
+    @jax.jit
+    def update_bcast(theta, xs, ys, masks):
+        k = len(xs)
+        if use_pallas:
+            thetas = jnp.broadcast_to(theta[None], (k,) + theta.shape)
+            deltas, losses = solver_b(thetas, jnp.stack(xs),
+                                      jnp.stack(ys), jnp.stack(masks))
+        else:
+            deltas, losses = jax.vmap(solver_1, in_axes=(None, 0, 0, 0))(
+                theta, jnp.stack(xs), jnp.stack(ys), jnp.stack(masks))
+        return unstack(deltas, k), unstack(losses, k)
+
+    @jax.jit
+    def update_eval_stacked(thetas, xs, ys, masks, test_x, test_y):
+        k = len(xs)
+        T = jnp.stack(thetas)
+        X, Y, M = jnp.stack(xs), jnp.stack(ys), jnp.stack(masks)
+        if use_pallas:
+            deltas, losses = solver_b(T, X, Y, M)
+            met = jax.vmap(lambda t, d: task.evaluate(t + d, test_x,
+                                                      test_y))(T, deltas)
+            f1s, accs = met.f1, met.accuracy
+        else:
+            deltas, losses, f1s, accs = jax.vmap(
+                composite, in_axes=(0, 0, 0, 0, None, None))(
+                    T, X, Y, M, test_x, test_y)
+        return (unstack(deltas, k), unstack(losses, k),
+                unstack(f1s, k), unstack(accs, k))
+
+    @jax.jit
+    def update_eval_bcast(theta, xs, ys, masks, test_x, test_y):
+        k = len(xs)
+        X, Y, M = jnp.stack(xs), jnp.stack(ys), jnp.stack(masks)
+        if use_pallas:
+            thetas = jnp.broadcast_to(theta[None], (k,) + theta.shape)
+            deltas, losses = solver_b(thetas, X, Y, M)
+            met = jax.vmap(lambda t, d: task.evaluate(t + d, test_x,
+                                                      test_y)
+                           )(thetas, deltas)
+            f1s, accs = met.f1, met.accuracy
+        else:
+            deltas, losses, f1s, accs = jax.vmap(
+                composite, in_axes=(None, 0, 0, 0, None, None))(
+                    theta, X, Y, M, test_x, test_y)
+        return (unstack(deltas, k), unstack(losses, k),
+                unstack(f1s, k), unstack(accs, k))
+
+    return {"update_stacked": update_stacked,
+            "update_bcast": update_bcast,
+            "update_eval_stacked": update_eval_stacked,
+            "update_eval_bcast": update_eval_bcast}
+
+
+def _gangable(worker) -> bool:
+    """A worker whose `on_weights` has been overridden on the INSTANCE
+    (test fault injectors, wrapper hooks) must keep the per-message
+    entry point — the gang's `_prepare`/`_finish` split would silently
+    bypass the wrapper.  Such workers are never claimed into a gang;
+    their messages stay queued for the normal single-dispatch path."""
+    return "on_weights" not in vars(worker)
+
+
+class GangDispatcher:
+    """Claims release sets and runs them as batched dispatches.
+
+    Serial drive: `drain_serial()` pops each GangNotice, claims every
+    member's weights message, and dispatches the whole set — fully
+    deterministic.  Threaded drive: worker threads route messages
+    through `offer()`; the first arrival covered by a notice leads the
+    gang, claiming only siblings ALREADY enqueued (non-blocking polls,
+    no sleeps — latecomers run solo on their own threads)."""
+
+    def __init__(self, workers, fabric, cfg, tracer=None):
+        self.workers = {w.worker_id: w for w in workers}
+        self.fabric = fabric
+        self.cfg = cfg
+        self.tracer = tracer or NULL_TRACER
+        self._offer_lock = threading.Lock()
+        # (worker_id, clock) -> the full member tuple of its notice
+        self._notices: dict[tuple[int, int], tuple] = {}
+        # grid pallas batching fell over at runtime -> vmap-of-kernel
+        self._grid = True
+
+    # -- drive-loop entries ------------------------------------------------
+
+    def drain_serial(self) -> bool:
+        """Consume every queued gang notice, claiming each release set
+        whole (the serial loop drains the set before dispatching).
+        Returns True if any dispatch ran."""
+        progressed = False
+        while True:
+            notice = self.fabric.poll(fabric_mod.GANG_TOPIC, 0)
+            if notice is None:
+                return progressed
+            members = []
+            for w, _ in notice.members:
+                if not _gangable(self.workers[w]):
+                    continue    # left queued for the per-message loop
+                msg = self.fabric.poll(fabric_mod.WEIGHTS_TOPIC, w)
+                if msg is not None:
+                    members.append((self.workers[w], msg))
+            if not members:
+                continue            # set already consumed elsewhere
+            if len(members) == 1:
+                members[0][0].on_weights(members[0][1])
+            else:
+                self.dispatch(members)
+            progressed = True
+
+    def offer(self, worker, msg) -> None:
+        """Threaded entry: first-arrival leadership.  The calling thread
+        pops the notice covering (worker, clock) — if there is one — and
+        claims siblings' weights messages still sitting in the fabric.
+        Members whose threads already popped their own message run solo
+        there (their notice entry is dropped so they cannot re-claim a
+        stale set).  All bookkeeping is non-blocking under one lock; the
+        batched dispatch itself runs outside it."""
+        if not _gangable(worker):
+            worker.on_weights(msg)
+            return
+        with self._offer_lock:
+            self._refresh_notices()
+            # entries superseded by this worker's own progress can never
+            # match again — drop them so the map stays bounded
+            for kc in [kc for kc in self._notices
+                       if kc[0] == worker.worker_id
+                       and kc[1] < msg.vector_clock]:
+                del self._notices[kc]
+            spec = self._notices.pop((worker.worker_id, msg.vector_clock),
+                                     None)
+            members = None
+            if spec is not None:
+                members = [(worker, msg)]
+                for w, _ in spec:
+                    if w == worker.worker_id:
+                        continue
+                    if not _gangable(self.workers[w]):
+                        continue    # its own thread delivers per-message
+                    sib = self.fabric.poll(fabric_mod.WEIGHTS_TOPIC, w)
+                    if sib is not None:
+                        members.append((self.workers[w], sib))
+                for w, c in spec:   # claimed: latecomers run solo
+                    self._notices.pop((w, c), None)
+        if members is None or len(members) == 1:
+            worker.on_weights(msg)
+        else:
+            self.dispatch(members)
+
+    def _refresh_notices(self) -> None:
+        while True:
+            notice = self.fabric.poll(fabric_mod.GANG_TOPIC, 0)
+            if notice is None:
+                return
+            for member in notice.members:
+                self._notices[member] = notice.members
+
+    # -- the batched step --------------------------------------------------
+
+    def dispatch(self, members) -> None:
+        """One batched device step for a claimed release set, preserving
+        per-message semantics exactly: members sort by worker id (the
+        serial per-message processing order), `_prepare`/`_finish` are
+        the worker's own halves, and the solver runs the same
+        per-element program vmapped.  Mixed eval cadence (bounded-delay
+        sets span clocks) partitions into at most one eval and one
+        non-eval dispatch; a partition of one keeps the single-dispatch
+        path.  Partial-range messages (range sharding) cannot stack —
+        the whole set degrades to per-message processing."""
+        members = sorted(members, key=lambda wm: wm[0].worker_id)
+        if any(m.key_range.start != 0
+               or m.key_range.end != w.task.num_params
+               for w, m in members):
+            for w, m in members:
+                w.on_weights(m)
+            return
+
+        failures: list[GangMemberError] = []
+        prepared = []
+        for w, m in members:
+            try:
+                prepared.append((w, m) + tuple(w._prepare(m)))
+            except BaseException as e:   # the healthy members still run
+                failures.append(GangMemberError(w.worker_id, e))
+        results: dict[int, tuple] = {}
+        eval_grp = [p for p in prepared if p[7]]
+        noeval_grp = [p for p in prepared if not p[7]]
+        for grp, with_eval in ((eval_grp, True), (noeval_grp, False)):
+            if grp:
+                self._dispatch_group(grp, with_eval, results)
+        # _finish in member order: CSV rows and GradientMessages hit
+        # their queues in exactly the per-message order
+        for p in prepared:
+            w, msg, _, _, _, _, seen, _ = p
+            w._finish(msg, seen, *results[w.worker_id])
+        if failures:
+            raise failures[0]
+
+    def _dispatch_group(self, grp, with_eval: bool, results: dict) -> None:
+        k = len(grp)
+        if k == 1:
+            w, msg, theta, x, y, mask, _, _ = grp[0]
+            update_fn, update_eval_fn = worker_mod._solver_fns(
+                self.cfg.task, self.cfg.model, self.cfg.use_pallas)
+            with self.tracer.span("worker.local_update",
+                                  worker=w.worker_id,
+                                  clock=msg.vector_clock):
+                if with_eval:
+                    delta, loss, f1, acc = update_eval_fn(
+                        theta, x, y, mask, w.test_x, w.test_y)
+                else:
+                    delta, loss = update_fn(theta, x, y, mask)
+                    f1 = acc = -1.0
+            self.tracer.count("dispatch.device")
+            results[w.worker_id] = (delta, loss, f1, acc)
+            return
+
+        thetas = [p[2] for p in grp]
+        xs = tuple(p[3] for p in grp)
+        ys = tuple(p[4] for p in grp)
+        masks = tuple(p[5] for p in grp)
+        # sequential release sets alias ONE server theta into every
+        # member message (server._weights_message), so identity — not a
+        # device-side compare — detects the broadcast case
+        shared = all(t is thetas[0] for t in thetas)
+        lead = grp[0][0]
+
+        def run(fns):
+            if with_eval:
+                if shared:
+                    return fns["update_eval_bcast"](
+                        thetas[0], xs, ys, masks, lead.test_x, lead.test_y)
+                return fns["update_eval_stacked"](
+                    tuple(thetas), xs, ys, masks, lead.test_x, lead.test_y)
+            if shared:
+                return fns["update_bcast"](thetas[0], xs, ys, masks)
+            return fns["update_stacked"](tuple(thetas), xs, ys, masks)
+
+        # same span name as the per-message path — one entry now covers
+        # k members (the `gang` arg distinguishes the two in traces)
+        with self.tracer.span("worker.local_update", gang=k,
+                              workers=[p[0].worker_id for p in grp]):
+            try:
+                out = run(_gang_solver_fns(self.cfg.task, self.cfg.model,
+                                           self.cfg.use_pallas,
+                                           grid=self._grid))
+            except Exception:
+                if not (self.cfg.use_pallas and self._grid):
+                    raise
+                # grid-over-worker-axis pallas unsupported here: fall
+                # back to vmap-of-kernel, once, and stay there
+                self._grid = False
+                out = run(_gang_solver_fns(self.cfg.task, self.cfg.model,
+                                           self.cfg.use_pallas,
+                                           grid=False))
+        self.tracer.count("dispatch.device")
+        self.tracer.count("gang.batched_dispatches")
+        self.tracer.count("gang.batched_members", k)
+        if with_eval:
+            deltas, losses, f1s, accs = out
+        else:
+            deltas, losses = out
+            f1s = accs = (-1.0,) * k
+        for p, d, l, f1, a in zip(grp, deltas, losses, f1s, accs):
+            results[p[0].worker_id] = (d, l, f1, a)

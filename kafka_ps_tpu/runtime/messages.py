@@ -76,6 +76,18 @@ class GradientMessage(BaseMessage):
 
 
 @dataclasses.dataclass(frozen=True)
+class GangNotice:
+    """Server → drive loop: the gate just released `members` (worker id,
+    clock) at the same moment, and their per-worker WeightsMessages are
+    in the fabric — a dispatcher may claim them as ONE batched device
+    step (runtime/gang.py).  Purely advisory: the per-worker messages
+    are the protocol; dropping a notice only costs the coalescing, and
+    it never crosses a serde boundary (fabric.send_transient)."""
+
+    members: tuple[tuple[int, int], ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class LabeledData:
     """One streamed sample: sparse features + label (LabeledData.java:14-28)."""
 

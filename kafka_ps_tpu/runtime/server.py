@@ -39,7 +39,8 @@ import numpy as np
 
 from kafka_ps_tpu.parallel.tracker import MessageTracker
 from kafka_ps_tpu.runtime import fabric as fabric_mod
-from kafka_ps_tpu.runtime.messages import GradientMessage, KeyRange, WeightsMessage
+from kafka_ps_tpu.runtime.messages import (GangNotice, GradientMessage,
+                                           KeyRange, WeightsMessage)
 from kafka_ps_tpu.utils import asynclog
 from kafka_ps_tpu.utils.config import EVENTUAL, PSConfig
 from kafka_ps_tpu.utils.trace import NULL_TRACER
@@ -74,6 +75,13 @@ class ServerNode:
             m = self.task.evaluate(t2, tx, ty)
             return t2, m
         self._apply_full_eval = jax.jit(_apply_eval)
+        # Batched (gang) apply programs, keyed on the static shape of a
+        # batch: (k, eval positions, prefix-theta positions).  Each is
+        # ONE jit'd dispatch that chains the k per-message updates —
+        # chained adds, NOT deltas.sum(0): float addition is not
+        # associative, and the acceptance bar is bitwise equality with
+        # k sequential _apply_full calls (docs/GANG_DISPATCH.md).
+        self._gang_apply_cache: dict = {}
         self.test_x = jnp.asarray(test_x) if test_x is not None else None
         self.test_y = jnp.asarray(test_y) if test_y is not None else None
         self.log = log or (lambda line: None)
@@ -126,6 +134,7 @@ class ServerNode:
             # double-deliver and break the clock protocol
             return
         self._loop_started = True
+        released: list[tuple[int, int]] = []
         for worker, status in enumerate(self.tracker.tracker):
             if not status.active:
                 continue
@@ -141,6 +150,7 @@ class ServerNode:
                 self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
                                  self._weights_message(status.vector_clock))
                 self.weights_sent_at[worker] = time.monotonic()
+                released.append((worker, status.vector_clock))
         delay = self.cfg.max_vector_clock_delay
         if delay == EVENTUAL:
             # eventual answers immediately, so any surviving pending
@@ -148,10 +158,14 @@ class ServerNode:
             for worker, s in enumerate(self.tracker.tracker):
                 if s.active and not s.weights_message_sent:
                     self.send_weights(worker, s.vector_clock)
+                    released.append((worker, s.vector_clock))
         else:
             # sequential == bounded with delay 0: the tracker's own
             # sendable predicate (MessageTracker.java:69-79)
-            self._flush_gate()
+            released.extend(self._flush_gate(notify=False))
+        # the bootstrap broadcast is one simultaneous release moment for
+        # every consistency model — one notice covers all of it
+        self._emit_gang_notice(sorted(released))
 
     def _weights_message(self, vector_clock: int) -> WeightsMessage:
         # device theta is immutable — safe to alias; a host-side theta
@@ -222,15 +236,43 @@ class ServerNode:
         self.send_weights(worker, clock)
         return clock
 
-    def _flush_gate(self) -> None:
+    def _flush_gate(self, notify: bool = True) -> list[tuple[int, int]]:
         """Send every reply the gate now permits (used after membership
-        changes — a removal can unblock rounds the dead worker held up)."""
+        changes — a removal can unblock rounds the dead worker held up).
+        Returns the release set; `notify=False` suppresses the gang
+        notice so a caller folding several release sources into one
+        simultaneous moment (start_training_loop) emits a single one."""
         delay = self.cfg.max_vector_clock_delay
         if delay == EVENTUAL:
-            return
-        for worker, clock in self.tracker.get_all_sendable_messages(
-                max(delay, 0)):
+            return []
+        release = sorted(self.tracker.get_all_sendable_messages(
+            max(delay, 0)))
+        for worker, clock in release:
             self.send_weights(worker, clock)
+        if notify:
+            self._emit_gang_notice(release)
+        return release
+
+    # -- gang dispatch (runtime/gang.py, docs/GANG_DISPATCH.md) ------------
+
+    def _emit_gang_notice(self, release: list[tuple[int, int]]) -> None:
+        """Publish a batched-weights notification for a multi-member
+        release set, ALONGSIDE the per-worker messages (which remain the
+        protocol — the notice is advisory and never serialized)."""
+        if self.cfg.use_gang and len(release) > 1:
+            self.fabric.send_transient(
+                fabric_mod.GANG_TOPIC, 0, GangNotice(members=tuple(release)))
+            self.tracer.count("server.gang_release_sets")
+
+    def dispatch_release_set(self, release) -> None:
+        """The consistency dispatch, as an explicit release set: sorted
+        per-worker sends (worker-id order keeps serial scheduling
+        deterministic) plus the gang notice when several workers were
+        released at the same moment."""
+        release = sorted(release)
+        for worker, clock in release:
+            self.send_weights(worker, clock)
+        self._emit_gang_notice(release)
 
     # -- the hot path (ServerProcessor.java:143-183) -----------------------
 
@@ -272,6 +314,7 @@ class ServerNode:
                 else:
                     self.theta = self._apply_full(jnp.asarray(self.theta),
                                                   msg.values)
+                self.tracer.count("dispatch.device")
             else:
                 host = np.array(self.theta)
                 host[r.start:r.end] += (self.cfg.server_lr
@@ -284,6 +327,7 @@ class ServerNode:
                 with self.tracer.span("server.eval", clock=msg.vector_clock):
                     m = self.task.evaluate(jnp.asarray(self.theta),
                                            self.test_x, self.test_y)
+                    self.tracer.count("dispatch.device")
             self.last_metrics = m            # device futures; float() syncs
             # schema: timestamp;partition;vectorClock;loss;fMeasure;accuracy
             # (ServerAppRunner.java:81); partition=-1 like the reference,
@@ -293,11 +337,171 @@ class ServerNode:
                 f"{int(time.time() * 1000)};-1;{msg.vector_clock};"
                 "{};{};{}", m.loss, m.f1, m.accuracy)
 
-        for worker, clock in self.workers_to_respond_to(msg.vector_clock,
-                                                        msg.worker_id):
-            self.send_weights(worker, clock)
+        self.dispatch_release_set(
+            self.workers_to_respond_to(msg.vector_clock, msg.worker_id))
 
         self.maybe_checkpoint()
+
+    def process_batch(self, msgs: list[GradientMessage]) -> None:
+        """Apply several queued gradients as ONE chained jit dispatch
+        (gang dispatch, docs/GANG_DISPATCH.md) — bitwise-identical to
+        calling `process` per message, cheaper by k-1 device round-trips.
+
+        Per-message semantics are preserved exactly:
+          * validation (zombie/duplicate drops) and the consistency gate
+            run INCREMENTALLY per message, in queue order — the gate for
+            message i sees the tracker state messages 0..i left behind,
+            so release decisions match the per-message path;
+          * gate bookkeeping (tracker.sent_message) happens at decision
+            time, but the fabric sends are deferred until the batched
+            apply yields each release's PREFIX theta — a mid-batch
+            release observes theta after exactly the deltas the
+            per-message path would have applied before it;
+          * evals land at the same clocks, computed on the same prefix
+            thetas, logged in the same row order;
+          * the update itself is a chain of adds inside one jit —
+            NOT deltas.sum(0), which is mathematically identical but
+            not bitwise (float addition is non-associative).
+        Checkpointing runs once at batch end (the crossing-based
+        trigger still fires on schedule); cadence is not part of the
+        bitwise contract.  Partial-range gradients (range sharding)
+        fall back to per-message processing.
+        """
+        full = all(m.key_range.start == 0
+                   and m.key_range.end == self.task.num_params
+                   for m in msgs)
+        if not full:
+            for m in msgs:
+                self.process(m)
+            return
+        # duplicate detection must see the clock advancement the EARLIER
+        # batch members will cause — a redelivered gradient can appear
+        # twice in one recovered backlog (at-least-once replay), and the
+        # per-message path would apply the first and drop the second.
+        # Simulate the advancement here; the tracker itself moves below.
+        live = []
+        ahead: dict[int, int] = {}
+        for m in msgs:
+            if not self.tracker.tracker[m.worker_id].active:
+                self.tracer.count("server.zombie_gradients_dropped")
+                continue
+            expected = ahead.get(
+                m.worker_id, self.tracker.tracker[m.worker_id].vector_clock)
+            if m.vector_clock < expected:
+                self.tracer.count("server.duplicate_gradients_dropped")
+                continue
+            ahead[m.worker_id] = m.vector_clock + 1
+            live.append(m)
+        if len(live) < 2:
+            for m in live:           # process() re-validates (no-op here)
+                self.process(m)
+            return
+
+        k = len(live)
+        eval_positions: list[int] = []
+        release_events: list[tuple[int, list[tuple[int, int]]]] = []
+        for i, m in enumerate(live):
+            self.tracker.received_message(m.worker_id, m.vector_clock)
+            self.tracer.count("server.gradients_applied")
+            if (m.worker_id == 0 and self.test_x is not None
+                    and m.vector_clock % self.cfg.eval_every == 0):
+                eval_positions.append(i)
+            release = sorted(self.workers_to_respond_to(m.vector_clock,
+                                                        m.worker_id))
+            for w, c in release:
+                self.tracker.sent_message(w, c)
+            if release:
+                release_events.append((i, release))
+        # releases at the last position see the final theta; earlier
+        # ones need their prefix returned from the jit
+        prefix_positions = tuple(sorted(
+            {i for i, _ in release_events if i < k - 1}))
+        fn = self._gang_apply_fn(k, tuple(eval_positions), prefix_positions)
+        # same span name as the per-message path — one entry now covers
+        # k chained applies (the `gang` arg distinguishes the two)
+        with self.tracer.span("server.apply", gang=k,
+                              workers=[m.worker_id for m in live]):
+            final_theta, prefixes, metrics = fn(
+                jnp.asarray(self.theta), self.test_x, self.test_y,
+                *[m.values for m in live])
+            self.iterations += k
+        self.tracer.count("dispatch.device")
+        self.tracer.count("server.gang_batched_applies")
+        self.theta = final_theta
+        prefix_theta = dict(zip(prefix_positions, prefixes))
+        release_at = dict(release_events)
+        eval_set = set(eval_positions)
+        mi = 0
+        batch_released: list[tuple[int, int]] = []
+        for i, m in enumerate(live):
+            if i in eval_set:
+                # the eval itself ran fused inside the batched apply;
+                # this span marks where its results enter the protocol
+                with self.tracer.span("server.eval",
+                                      clock=m.vector_clock, fused=True):
+                    met = metrics[mi]
+                    mi += 1
+                    self.last_metrics = met
+                    asynclog.submit_or_write(
+                        self.log,
+                        f"{int(time.time() * 1000)};-1;{m.vector_clock};"
+                        "{};{};{}", met.loss, met.f1, met.accuracy)
+            rel = release_at.get(i)
+            if rel:
+                theta_i = prefix_theta.get(i, final_theta)
+                for worker, clock in rel:
+                    self._send_weights_prepared(worker, clock, theta_i)
+                batch_released.extend(rel)
+        # ONE notice for everything this batch released: the release
+        # events are simultaneous from the drive loop's point of view
+        # (all sends above happened before any worker ran), and the gang
+        # stacks per-member thetas, so mid-batch releases with prefix
+        # thetas coalesce as well as end-of-batch ones.  This is what
+        # lets the eventual model gang in steady state — its per-message
+        # releases are all singletons.
+        self._emit_gang_notice(sorted(batch_released))
+        self.maybe_checkpoint()
+
+    def _gang_apply_fn(self, k: int, eval_positions: tuple,
+                       prefix_positions: tuple):
+        """One jit'd program per batch shape: chain k updates, returning
+        (final theta, prefix thetas at `prefix_positions`, metrics at
+        `eval_positions`) — a single dispatch whatever the batch asks."""
+        key = (k, eval_positions, prefix_positions)
+        fn = self._gang_apply_cache.get(key)
+        if fn is None:
+            import jax
+            lr = self.cfg.server_lr
+            task = self.task
+            eval_set = frozenset(eval_positions)
+            prefix_set = frozenset(prefix_positions)
+
+            def chain(t, tx, ty, *deltas):
+                prefixes, metrics = [], []
+                for i, d in enumerate(deltas):
+                    t = t + lr * d
+                    if i in prefix_set:
+                        prefixes.append(t)
+                    if i in eval_set:
+                        metrics.append(task.evaluate(t, tx, ty))
+                return t, prefixes, metrics
+
+            fn = jax.jit(chain)
+            self._gang_apply_cache[key] = fn
+        return fn
+
+    def _send_weights_prepared(self, worker: int, clock: int,
+                               theta) -> None:
+        """Fabric send for a release whose gate bookkeeping already ran
+        (process_batch records tracker.sent_message at gate-decision
+        time; the send waits for the batched apply to yield the prefix
+        theta this release observes)."""
+        self.fabric.send(
+            fabric_mod.WEIGHTS_TOPIC, worker,
+            WeightsMessage(vector_clock=clock,
+                           key_range=KeyRange(0, self.task.num_params),
+                           values=theta))
+        self.weights_sent_at[worker] = time.monotonic()
 
     def maybe_checkpoint(self) -> None:
         """Save once every `checkpoint_every` applied iterations —

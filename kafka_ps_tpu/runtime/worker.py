@@ -113,7 +113,11 @@ class WorkerNode:
         # runtime/app.py): wall-clock of the last completed iteration
         self.last_progress = time.monotonic()
 
-    def on_weights(self, msg: WeightsMessage) -> None:
+    def _prepare(self, msg: WeightsMessage):
+        """Pre-dispatch half of an iteration, shared by the single-
+        dispatch path (on_weights) and the gang path (runtime/gang.py):
+        heartbeat, theta overwrite, slab snapshot/version cache.
+        Returns (theta, x, y, mask, num_tuples_seen, want_eval)."""
         # heartbeat: starting an iteration counts as liveness, so a slow
         # (e.g. first-compile) iteration is measured from its own start
         self.last_progress = time.monotonic()
@@ -140,30 +144,16 @@ class WorkerNode:
             self._slab = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask))
             self._slab_version = seen
         x, y, mask = self._slab
+        want_eval = (self.test_x is not None
+                     and msg.vector_clock % self.cfg.eval_every == 0)
+        return jnp.asarray(self.theta), x, y, mask, seen, want_eval
 
-        # Post-fit test metrics, like the reference's per-iteration eval
-        # inside calculateGradients (LogisticRegressionTaskSpark.java:186).
-        # eval_every > 1 skips the full-test-set evaluation on
-        # off-cadence clocks, logging the reference's own "-1 = not
-        # computed" placeholder (ServerProcessor.java:158-164 uses it
-        # for loss).  All numeric fields stay device futures — the line
-        # is formatted when they resolve (utils/asynclog.DeferredSink).
-        # Eval iterations fuse solver + evaluate into ONE dispatch
-        # (_solver_fns): per-dispatch host latency is what bounds the
-        # per-node path on a tunneled transport.
-        update_fn, update_eval_fn = _solver_fns(
-            self.cfg.task, self.cfg.model, self.cfg.use_pallas)
-        f1, acc = -1.0, -1.0
-        with self.tracer.span("worker.local_update", worker=self.worker_id,
-                              clock=msg.vector_clock):
-            if (self.test_x is not None
-                    and msg.vector_clock % self.cfg.eval_every == 0):
-                delta, loss, f1, acc = update_eval_fn(
-                    jnp.asarray(self.theta), x, y, mask,
-                    self.test_x, self.test_y)
-            else:
-                delta, loss = update_fn(jnp.asarray(self.theta), x, y, mask)
-
+    def _finish(self, msg: WeightsMessage, seen: int,
+                delta, loss, f1, acc) -> None:
+        """Post-dispatch half, shared by both paths: the per-worker CSV
+        row (fields stay device futures), the iteration count, and the
+        per-worker GradientMessage — identical whether the solver ran
+        solo or stacked inside a gang."""
         # schema: timestamp;partition;vectorClock;loss;fMeasure;accuracy;
         # numTuplesSeen (WorkerAppRunner.java:80,
         # WorkerTrainingProcessor.java:85-92)
@@ -182,3 +172,30 @@ class WorkerNode:
                 values=delta,
                 worker_id=self.worker_id))
         self.last_progress = time.monotonic()
+
+    def on_weights(self, msg: WeightsMessage) -> None:
+        theta, x, y, mask, seen, want_eval = self._prepare(msg)
+
+        # Post-fit test metrics, like the reference's per-iteration eval
+        # inside calculateGradients (LogisticRegressionTaskSpark.java:186).
+        # eval_every > 1 skips the full-test-set evaluation on
+        # off-cadence clocks, logging the reference's own "-1 = not
+        # computed" placeholder (ServerProcessor.java:158-164 uses it
+        # for loss).  All numeric fields stay device futures — the line
+        # is formatted when they resolve (utils/asynclog.DeferredSink).
+        # Eval iterations fuse solver + evaluate into ONE dispatch
+        # (_solver_fns): per-dispatch host latency is what bounds the
+        # per-node path on a tunneled transport.
+        update_fn, update_eval_fn = _solver_fns(
+            self.cfg.task, self.cfg.model, self.cfg.use_pallas)
+        f1, acc = -1.0, -1.0
+        with self.tracer.span("worker.local_update", worker=self.worker_id,
+                              clock=msg.vector_clock):
+            if want_eval:
+                delta, loss, f1, acc = update_eval_fn(
+                    theta, x, y, mask, self.test_x, self.test_y)
+            else:
+                delta, loss = update_fn(theta, x, y, mask)
+        self.tracer.count("dispatch.device")
+
+        self._finish(msg, seen, delta, loss, f1, acc)

@@ -18,10 +18,16 @@ device-resident futures:
   * flush() forces everything out in one batched fetch (drive loops
     call it on exit so callers always see complete logs).
 
-FIFO is preserved per sink — batches pop and emit under one emit lock,
-so a CSV shared by several workers keeps the arrival order the
-staleness auditor's tie-breaking relies on (evaluation/validate.py
-sorts stably by timestamp, file order breaking ms collisions).
+FIFO is preserved per sink by a ticket turnstile: a batch takes its
+ticket atomically with popping its entries (under the pending lock),
+formats and fetches OUTSIDE any lock, and emits when the turnstile
+reaches its ticket — so a CSV shared by several workers keeps the
+arrival order the staleness auditor's tie-breaking relies on
+(evaluation/validate.py sorts stably by timestamp, file order breaking
+ms collisions), while a slow batch (e.g. the poisoned-batch per-value
+fallback, N tunnel round-trips) no longer serializes other batches'
+device fetches behind a held emit lock — they fetch concurrently and
+only the cheap ordered sink writes queue up.
 """
 
 from __future__ import annotations
@@ -86,8 +92,15 @@ class DeferredSink:
         self._max_pending = max_pending
         self._interval = drain_interval
         self._idle_exit = idle_exit
-        self._lock = threading.Lock()        # guards _pending
-        self._emit_lock = threading.Lock()   # serializes pop+emit
+        self._lock = threading.Lock()        # guards _pending + tickets
+        # emission turnstile: tickets are taken under _lock, atomically
+        # with popping the entries they cover, so ticket order == entry
+        # order; emission happens strictly in ticket order but the
+        # formatting (device fetches) between take and emit runs
+        # unlocked and concurrent
+        self._turn_cv = threading.Condition()
+        self._next_ticket = 0
+        self._turn = 0
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -107,17 +120,12 @@ class DeferredSink:
             if self._pending or self._thread is not None:
                 self._pending.append((line, ()))
                 return
-        # pure-string sink right now: emit straight through — under the
-        # emit lock, because the drain thread may since have idle-exited
-        # mid-_emit_batch and an unlocked write here could land between
-        # a batch's earlier rows (the FIFO the auditor's tie-breaking
-        # relies on).  Re-check under both locks before writing.
-        with self._emit_lock:
-            with self._lock:
-                if self._pending or self._thread is not None:
-                    self._pending.append((line, ()))
-                    return
-            self._sink(line)
+            # pure-string sink right now: take a ticket so the write
+            # lands AFTER any batch a drain/flush already popped (their
+            # tickets are earlier) — the FIFO the auditor's tie-breaking
+            # relies on, without re-checking under a second lock
+            ticket = self._take_ticket_locked()
+        self._emit_in_turn(ticket, (line,))
 
     # -- drain side --------------------------------------------------------
 
@@ -155,22 +163,52 @@ class DeferredSink:
                         self._thread = None
                     return
 
-    def _drain_ready(self) -> None:
-        with self._emit_lock:
-            ready = []
-            with self._lock:
-                while self._pending:
-                    _, values = self._pending[0]
-                    if not all(_is_ready(v) for v in values):
-                        break
-                    ready.append(self._pending.popleft())
-            if ready:
-                self._emit_batch(ready)
+    def _take_ticket_locked(self) -> int:
+        """Issue the next turnstile ticket; caller must hold _lock (the
+        ticket must be atomic with the pop it covers).  EVERY ticket
+        taken must reach _emit_in_turn, even on error — callers wrap the
+        formatting in try/finally."""
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        return ticket
 
-    def _emit_batch(self, entries) -> None:
-        """Format + emit entries in order, fetching every device scalar
-        they reference in ONE stacked transfer (a per-scalar fetch is a
-        full tunnel round-trip; N at once cost the same as one)."""
+    def _emit_in_turn(self, ticket: int, lines) -> None:
+        """Write `lines` to the sink when the turnstile reaches
+        `ticket`; always advances the turn, so a failed batch cannot
+        wedge every later emitter."""
+        with self._turn_cv:
+            self._turn_cv.wait_for(lambda: self._turn == ticket)
+            try:
+                for line in lines:
+                    self._sink(line)
+            finally:
+                self._turn += 1
+                self._turn_cv.notify_all()
+
+    def _drain_ready(self) -> None:
+        with self._lock:
+            ready = []
+            while self._pending:
+                _, values = self._pending[0]
+                if not all(_is_ready(v) for v in values):
+                    break
+                ready.append(self._pending.popleft())
+            if not ready:
+                return
+            ticket = self._take_ticket_locked()
+        lines: list[str] = []
+        try:
+            lines = self._format_entries(ready)
+        finally:
+            self._emit_in_turn(ticket, lines)
+
+    def _format_entries(self, entries) -> list[str]:
+        """Format entries in order, fetching every device scalar they
+        reference in ONE stacked transfer (a per-scalar fetch is a full
+        tunnel round-trip; N at once cost the same as one).  Runs with
+        NO lock held: the poisoned-batch fallback below degrades to N
+        per-value round-trips, and those must overlap other batches'
+        fetches, not serialize them."""
         jax_vals = [v for _, values in entries for v in values
                     if _is_jax(v)]
         fetched: dict[int, float] = {}
@@ -195,21 +233,30 @@ class DeferredSink:
             except Exception:
                 return float("nan")
 
+        lines = []
         for template, values in entries:
             if values:
                 template = template.format(*(resolve(v) for v in values))
-            self._sink(template)
+            lines.append(template)
+        return lines
 
     def flush_ready(self) -> None:
         self._drain_ready()
 
     def flush(self) -> None:
-        with self._emit_lock:
-            with self._lock:
-                entries = list(self._pending)
-                self._pending.clear()
+        with self._lock:
+            entries = list(self._pending)
+            self._pending.clear()
+            # a ticket even when empty: flush doubles as an emission
+            # barrier — by the time our turn has come and gone, every
+            # batch popped before this point has been written
+            ticket = self._take_ticket_locked()
+        lines: list[str] = []
+        try:
             if entries:
-                self._emit_batch(entries)
+                lines = self._format_entries(entries)
+        finally:
+            self._emit_in_turn(ticket, lines)
 
     def close(self) -> None:
         self._stop.set()

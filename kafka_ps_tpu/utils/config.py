@@ -85,6 +85,13 @@ class PSConfig:
     # worker iterations; falls back to the XLA path off-TPU or when the
     # buffer exceeds the VMEM budget.
     use_pallas: bool = False
+    # Gang-scheduled dispatch (runtime/gang.py, docs/GANG_DISPATCH.md):
+    # coalesce workers released by the consistency gate at the same
+    # moment into one batched device step.  On by default for the
+    # serial/threaded drive loops; `--no-gang` restores the per-message
+    # path.  In-process fabrics only — socket mode forces it off (the
+    # wire protocol has no gang notice frame).
+    use_gang: bool = True
 
     @property
     def server_lr(self) -> float:

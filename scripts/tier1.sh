@@ -3,7 +3,51 @@
 # Passes iff the suite exits 0 within the timeout; DOTS_PASSED echoes
 # the progress-dot count so regressions against the recorded floor are
 # visible at a glance.
+#
+# `scripts/tier1.sh --gang` runs the gang-dispatch smoke leg instead: a
+# tiny serial run with coalescing on vs off, asserting identical final
+# theta (bitwise) and a strictly lower device-dispatch count
+# (docs/GANG_DISPATCH.md).
 set -o pipefail
+
+if [[ "${1:-}" == "--gang" ]]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+from kafka_ps_tpu.runtime.app import StreamingPSApp
+from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig, PSConfig,
+                                       StreamConfig)
+from kafka_ps_tpu.utils.trace import Tracer
+
+def run(use_gang):
+    cfg = PSConfig(num_workers=4, consistency_model=0,
+                   model=ModelConfig(num_features=8, num_classes=2,
+                                     local_learning_rate=0.5),
+                   buffer=BufferConfig(min_size=8, max_size=32),
+                   stream=StreamConfig(time_per_event_ms=1.0),
+                   use_gang=use_gang)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 8)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32) + 1
+    tracer = Tracer()
+    app = StreamingPSApp(cfg, test_x=x, test_y=y, tracer=tracer)
+    for i in range(128):
+        app.buffers[i % 4].add({j: float(x[i, j]) for j in range(8)},
+                               int(y[i]))
+    app.run_serial(24)
+    return (np.asarray(app.server.theta),
+            tracer.counters().get("dispatch.device", 0))
+
+theta_on, disp_on = run(True)
+theta_off, disp_off = run(False)
+assert theta_on.tobytes() == theta_off.tobytes(), \
+    "gang smoke: final theta diverged from the per-message path"
+assert disp_on < disp_off, \
+    f"gang smoke: dispatch count did not drop ({disp_on} vs {disp_off})"
+print(f"GANG_SMOKE_OK dispatches {disp_on} vs {disp_off} per-message")
+EOF
+    exit $?
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \

@@ -338,6 +338,29 @@ def test_crash_truncated_epoch_tail_does_not_fake_staleness():
     assert any(x.rule == "staleness-bound" for x in v)
 
 
+def test_crash_truncation_exemption_names_dropped_worker():
+    """The exemption is a deliberate blind spot — auditors must be told
+    WHICH worker stopped constraining the spread, once per epoch."""
+    rows = []
+    for c in range(2):
+        rows.append({"timestamp": 1000 + 10 * c, "partition": 1,
+                     "vectorClock": c})
+    for c in range(10):
+        rows.append({"timestamp": 1001 + 10 * c, "partition": 0,
+                     "vectorClock": c})
+    for c in range(2, 6):
+        rows.append({"timestamp": 5000 + 10 * c, "partition": 1,
+                     "vectorClock": c})
+        rows.append({"timestamp": 5001 + 10 * c, "partition": 0,
+                     "vectorClock": c + 1})
+    df = pd.DataFrame(rows)
+    events = [(3000, "resume", -1)]
+    with pytest.warns(UserWarning,
+                      match="worker 1 exempted from the spread check"):
+        assert validate.validate_worker_log(
+            df, 3, membership_events=events) == []
+
+
 def test_membership_events_auto_enable_epoch_auditing():
     """Passing membership events without elastic=True must still take
     the epoch-aware path: the static contract is provably void across
