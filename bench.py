@@ -28,6 +28,8 @@ Paths measured:
   * pallas fused local-update kernel vs the XLA path (A/B)
   * per-node (message-driven) runtime at eval_every=1 (reference
     cadence) and eval_every=10 (the throughput/cadence trade-off knob)
+  * serving plane A/B (docs/SERVING.md): batched vs unbatched
+    prediction under concurrent load — dispatches/request and p50/p99
   * roofline block (docs/ROOFLINE.md): analytic FLOPs/bytes per update,
     MFU vs datasheet bf16 peak AND vs a measured square-matmul ceiling
     on the same chip, plus a hidden_dim sweep showing the MLP path
@@ -199,6 +201,78 @@ def matmul_calibration(jnp, jax, n: int = 4096) -> dict:
         out[f"matmul_{name}_tflops"] = stats["median"]
         out[f"matmul_{name}_tflops_iqr"] = stats["iqr"]
     return out
+
+
+def serving_ab(theta, cfg, trials: int = 3, threads: int = 4,
+               per_thread: int = 64) -> dict:
+    """Batched vs unbatched prediction serving (docs/SERVING.md).
+
+    Both arms run the SAME concurrent load — `threads` client threads
+    each issuing `per_thread` synchronous predicts against a registry
+    holding the trained theta.  The batched arm micro-batches under a
+    2 ms deadline (serving/engine.py defaults); the unbatched arm pins
+    max_batch=1 / deadline=0, i.e. one jit dispatch per request.  The
+    auditable claim is dispatches_per_request < 1 under concurrency —
+    the serving-plane mirror of the gang-dispatch ratio; latency medians
+    ride along for the trade-off (batching buys dispatch amortization
+    at up to one deadline of added p50)."""
+    import threading as _threading
+
+    from kafka_ps_tpu.models.task import get_task
+    from kafka_ps_tpu.serving import SnapshotRegistry
+    from kafka_ps_tpu.serving.engine import PredictionEngine
+
+    task = get_task("logreg", cfg)
+    rng = np.random.default_rng(7)
+    xs = rng.standard_normal((threads, per_thread, cfg.num_features)
+                             ).astype(np.float32)
+
+    def run_arm(max_batch: int, deadline_s: float) -> dict:
+        registry = SnapshotRegistry()
+        registry.publish(theta, vector_clock=1)
+        eng = PredictionEngine(task, registry, max_batch=max_batch,
+                               deadline_s=deadline_s)
+        try:
+            eng.predict(xs[0, 0])                    # compile + warm
+            qps = []
+            for _ in range(trials):
+                def drive(t):
+                    for j in range(per_thread):
+                        eng.predict(xs[t, j])
+                ths = [_threading.Thread(target=drive, args=(t,))
+                       for t in range(threads)]
+                t0 = time.perf_counter()
+                for th in ths:
+                    th.start()
+                for th in ths:
+                    th.join()
+                qps.append(threads * per_thread
+                           / (time.perf_counter() - t0))
+            s = eng.stats()
+            return {
+                "predictions_per_sec": rate_stats(qps),
+                "requests": s["requests"],
+                "dispatches": s["batches"],
+                "dispatches_per_request": round(
+                    s["batches"] / max(s["requests"], 1), 3),
+                "occupancy": s["occupancy"],
+                "p50_ms": s["p50_ms"],
+                "p99_ms": s["p99_ms"],
+            }
+        finally:
+            eng.close()
+
+    batched = run_arm(16, 0.002)
+    unbatched = run_arm(1, 0.0)
+    return {
+        "concurrency": threads,
+        "requests_per_thread": per_thread,
+        "batched": batched,
+        "unbatched": unbatched,
+        "batching_speedup": round(
+            batched["predictions_per_sec"]["median"]
+            / max(unbatched["predictions_per_sec"]["median"], 1e-9), 3),
+    }
 
 
 def runtime_mlp4096(trials: int) -> tuple[dict, float]:
@@ -491,6 +565,9 @@ def main() -> None:
                "eval_every_10": gang_arm(per_node_eval10,
                                          per_node_nogang_10)}
 
+    # -- serving plane A/B (docs/SERVING.md) -------------------------------
+    serving = serving_ab(theta, cfg, trials=3)
+
     baseline = 1.85   # best aggregate worker-updates/s in reference logs
     payload = {
         "metric": "worker_updates_per_sec",
@@ -517,6 +594,7 @@ def main() -> None:
                 "per_node_iters_per_sec_eval_every_1": per_node_ref_cadence,
                 "per_node_iters_per_sec_eval_every_10": per_node_eval10,
                 "gang_ab": gang_ab,
+                "serving_ab": serving,
             },
             "roofline": {
                 "device_kind": getattr(dev, "device_kind", "unknown"),
@@ -561,6 +639,9 @@ def main() -> None:
                 "pallas_speedup"),
             "mlp4096_runtime_over_kernel": d["paths"][
                 "mlp4096_full_runtime"]["runtime_over_kernel"],
+            "serving_dispatches_per_request": d["paths"]["serving_ab"][
+                "batched"]["dispatches_per_request"],
+            "serving_p50_ms": d["paths"]["serving_ab"]["batched"]["p50_ms"],
         },
         "detail_file": "bench_out.json",
     }))

@@ -138,6 +138,26 @@ def build_parser(include_server_flags: bool = True,
                    help="--durable-log fsync policy: page-cache only / "
                         "at most once per second / every append "
                         "(log/log.py)")
+    # -- online serving plane (kafka_ps_tpu/serving/, docs/SERVING.md) --
+    p.add_argument("--serve", action="store_true",
+                   help="serve predictions while training: the server "
+                        "publishes a weights snapshot at every "
+                        "consistency-gate release and a micro-batching "
+                        "engine answers staleness-bounded reads against "
+                        "the newest one (never blocks training)")
+    p.add_argument("--serve_port", type=int, default=None, metavar="PORT",
+                   help="with --serve: also accept T_PREDICT frames on "
+                        "this TCP port (0 = ephemeral; the bound port is "
+                        "printed to stderr).  Omit for in-process-only "
+                        "serving")
+    p.add_argument("--serve_batch", type=int, default=16,
+                   help="serving micro-batch size cap (one jit shape; "
+                        "the gang-dispatch analogue for reads)")
+    p.add_argument("--serve_deadline_ms", type=float, default=2.0,
+                   help="max milliseconds a prediction waits for its "
+                        "micro-batch to fill")
+    p.add_argument("--serve_snapshots", type=int, default=8,
+                   help="snapshot ring capacity (exact-clock audit reads)")
     return p
 
 
@@ -160,7 +180,8 @@ def make_app_from_args(args, resuming: bool = False,
     writer per file on a shared filesystem (deploy/README.md)."""
     from kafka_ps_tpu.runtime.app import StreamingPSApp
     from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
-                                           PSConfig, StreamConfig)
+                                           PSConfig, ServingConfig,
+                                           StreamConfig)
     from kafka_ps_tpu.utils.csvlog import (CsvLogSink, NullLogSink,
                                            SERVER_HEADER, WORKER_HEADER)
 
@@ -180,6 +201,12 @@ def make_app_from_args(args, resuming: bool = False,
         use_pallas=args.pallas,
         eval_every=getattr(args, "eval_every", 1),
         use_gang=not getattr(args, "no_gang", False),
+        serving=ServingConfig(
+            enabled=getattr(args, "serve", False),
+            port=getattr(args, "serve_port", None),
+            max_batch=getattr(args, "serve_batch", 16),
+            deadline_ms=getattr(args, "serve_deadline_ms", 2.0),
+            ring_capacity=getattr(args, "serve_snapshots", 8)),
     )
     test_x, test_y = load_test_csv(args.test_data_file_path,
                                    args.num_features)
@@ -246,6 +273,9 @@ def run_with_args(args) -> int:
         raise SystemExit(
             "--pallas implements the logreg and mlp local updates "
             f"(ops/fused_update.py); got --task {args.task}")
+    if getattr(args, "serve_port", None) is not None \
+            and not getattr(args, "serve", False):
+        raise SystemExit("--serve_port requires --serve")
     distributed = False
     if args.remote:
         from kafka_ps_tpu.parallel import multihost
@@ -320,6 +350,35 @@ def run_with_args(args) -> int:
         if args.verbose:
             print(f"    durable-log replay: {counts}")
 
+    serve_bridge = None
+    if getattr(args, "serve", False):
+        if distributed:
+            raise SystemExit(
+                "--serve is single-process: the serving plane reads the "
+                "server's snapshot registry in-process (run a dedicated "
+                "serving host against the checkpoint instead)")
+        engine = app.enable_serving()
+        # cold start (docs/SERVING.md): the restored (or fresh) theta is
+        # servable before the first gate release...
+        app.server.publish_snapshot()
+        if getattr(args, "durable_log", None):
+            # ...and when the durable log holds RELEASED weights strictly
+            # ahead of the restored stable clock, publish those too —
+            # readers immediately see everything the dead process had
+            # already promised to some worker
+            latest = app.fabric.latest_logged_weights()
+            if (latest is not None
+                    and latest.vector_clock > app.server.serving_clock()):
+                app.server.publish_snapshot(latest.values,
+                                            latest.vector_clock)
+        if getattr(args, "serve_port", None) is not None:
+            from kafka_ps_tpu.runtime import net
+            serve_bridge = net.ServerBridge(port=args.serve_port,
+                                            run_id=app.server.run_id)
+            serve_bridge.attach_serving(engine)
+            print(f"serving on port {serve_bridge.port}",
+                  file=sys.stderr, flush=True)
+
     # mesh + data-partition assignment come AFTER checkpoint restore: a
     # restored checkpoint can carry evictions, and both the divisibility
     # check and the local-worker filter must see the real membership
@@ -389,6 +448,12 @@ def run_with_args(args) -> int:
         # producer sinks rows into numpy slabs and the deferred-log
         # drain threads dispatch device fetches
         producer.stop()
+        # serving teardown: close the socket endpoint FIRST (stops new
+        # requests), then the engine's batcher thread (holds jit'd
+        # callables — joined before interpreter exit)
+        if serve_bridge is not None:
+            serve_bridge.close()
+        app.close_serving()
         if args.checkpoint and process_index == 0:
             # routed through the server so a durable fabric commits the
             # offsets this final snapshot covers (a commit point)

@@ -130,6 +130,25 @@ def run_server(args) -> int:
             print(f"restored checkpoint at iteration {server.iterations}",
                   file=sys.stderr, flush=True)
 
+    # online serving plane on the SAME port as the workers: predict-only
+    # clients never HELLO, so the bridge routes them nothing but their
+    # own T_PREDICTION replies (docs/SERVING.md)
+    engine = None
+    if getattr(args, "serve", False):
+        from kafka_ps_tpu.serving.engine import PredictionEngine
+        from kafka_ps_tpu.serving.snapshot import SnapshotRegistry
+        registry = SnapshotRegistry(
+            capacity=getattr(args, "serve_snapshots", 8))
+        server.serving = registry
+        engine = PredictionEngine(
+            server.task, registry,
+            max_batch=getattr(args, "serve_batch", 16),
+            deadline_s=getattr(args, "serve_deadline_ms", 2.0) / 1000.0)
+        bridge.attach_serving(engine)
+        server.publish_snapshot()    # cold start: restored/fresh theta
+        print(f"serving predictions on port {bridge.port}",
+              file=sys.stderr, flush=True)
+
     # membership events cross threads (bridge readers -> main loop):
     # ServerNode is single-threaded by design, so evictions/readmissions
     # are applied only between gradient polls
@@ -210,7 +229,7 @@ def run_server(args) -> int:
     def status() -> dict:
         tr = server.tracker
         active = tr.active_workers
-        return {
+        out = {
             "iters": server.iterations,
             "clocks": [f"{w}:{tr.tracker[w].vector_clock}"
                        for w in range(cfg.num_workers)],
@@ -219,6 +238,13 @@ def run_server(args) -> int:
                 fabric_mod.GRADIENTS_TOPIC)},
             "rows_sent": producer.rows_sent,
         }
+        if engine is not None:
+            s = engine.stats()
+            out["predictions_per_s"] = s["requests"]
+            out["serving"] = {"occ": s["occupancy"],
+                              "p50_ms": s["p50_ms"], "p99_ms": s["p99_ms"],
+                              "stale": s["rejections"]}
+        return out
 
     reporter = StatusReporter(getattr(args, "status_every", 0.0) or 0.0,
                               status).start()
@@ -243,6 +269,8 @@ def run_server(args) -> int:
                              # may outlive the main thread)
         bridge.close()       # workers see EOF and shut down; joins
                              # accept/heartbeat/reader threads
+        if engine is not None:
+            engine.close()   # after the bridge: no reader can submit now
         if checkpoint_path:
             from kafka_ps_tpu.utils import checkpoint as ckpt
             ckpt.save(checkpoint_path, server)

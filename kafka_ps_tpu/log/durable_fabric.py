@@ -164,6 +164,30 @@ class DurableFabric(Fabric):
         for offset, payload in self.manager.get(topic, key).read_from(start):
             yield offset, serde.from_bytes(payload)
 
+    def latest_logged_weights(self):
+        """The newest logged WeightsMessage (by vector clock) across all
+        WEIGHTS partitions, or None when none was ever logged.
+
+        Serve-from-cold-start freshness (docs/SERVING.md): a restarting
+        `--serve` process publishes the restored checkpoint theta as its
+        first snapshot, then — when the log's newest released weights
+        are strictly ahead of the restored stable clock — publishes that
+        record too, so readers immediately see everything the dead
+        process had already RELEASED (a released message is a promise:
+        some worker may have observed it pre-crash)."""
+        best = None
+        for topic, key in self.manager.partitions(WEIGHTS_TOPIC):
+            last_payload = None
+            for _offset, payload in self.manager.get(topic,
+                                                     key).read_from(0):
+                last_payload = payload   # per-partition clocks ascend
+            if last_payload is None:
+                continue
+            msg = serde.from_bytes(last_payload)
+            if best is None or msg.vector_clock > best.vector_clock:
+                best = msg
+        return best
+
     def recover(self, checkpoint_offsets: dict[str, int] | None = None
                 ) -> dict[str, int]:
         """Re-enqueue the unconsumed WEIGHTS / GRADIENTS tail into the

@@ -106,6 +106,11 @@ class MLPTask:
     def evaluate(self, theta, x_test, y_test) -> metrics_mod.Metrics:
         return _evaluate(theta, x_test, y_test, cfg=self.cfg)
 
+    def predict_logits(self, theta, x):
+        """(B, F) → (B, C) class scores — the serving plane's forward
+        pass (kafka_ps_tpu/serving/engine.py)."""
+        return logits(unflatten(theta, self.cfg), x)
+
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def _local_update_onehot(theta, x, onehot, mask, *, cfg: ModelConfig):

@@ -50,6 +50,8 @@ class MLTask(Protocol):
 
     def evaluate(self, theta, x_test, y_test) -> metrics_mod.Metrics: ...
 
+    def predict_logits(self, theta, x) -> jax.Array: ...
+
 
 class LogRegTask:
     """The reference's model: multinomial LR over the flat
@@ -74,6 +76,11 @@ class LogRegTask:
 
     def evaluate(self, theta, x_test, y_test) -> metrics_mod.Metrics:
         return metrics_mod.evaluate(theta, x_test, y_test, cfg=self.cfg)
+
+    def predict_logits(self, theta, x):
+        """(B, F) → (B, C+1) class scores — the serving plane's forward
+        pass (kafka_ps_tpu/serving/engine.py)."""
+        return logreg.logits(logreg.unflatten(theta, self.cfg), x)
 
 
 _REGISTRY = {"logreg": LogRegTask}

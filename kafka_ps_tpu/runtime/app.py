@@ -82,6 +82,9 @@ class StreamingPSApp:
         # first N" is exactly-once re-ingestion; set by recover_durable)
         self._ingest_skip = 0
         self.worker_failures: list[tuple[int, BaseException | str]] = []
+        # online serving plane (kafka_ps_tpu/serving/): built on demand
+        # by enable_serving(); None keeps the app purely a trainer
+        self.serving_engine = None
         # Multi-host: the subset of logical workers this process hosts
         # (None = all).  Every host streams the same CSV with the same
         # global round-robin, keeping only its own workers' rows — the
@@ -196,6 +199,33 @@ class StreamingPSApp:
         counts[fabric_mod.INPUT_DATA_TOPIC] = replayed_rows
         return counts
 
+    # -- serving plane (kafka_ps_tpu/serving/, docs/SERVING.md) ------------
+
+    def enable_serving(self):
+        """Attach the online serving plane: a SnapshotRegistry on the
+        server (publish at every gate release) plus a PredictionEngine
+        batching reads against it.  Sized by cfg.serving.  Idempotent;
+        returns the engine."""
+        if self.serving_engine is not None:
+            return self.serving_engine
+        from kafka_ps_tpu.serving.engine import PredictionEngine
+        from kafka_ps_tpu.serving.snapshot import SnapshotRegistry
+        scfg = self.cfg.serving
+        registry = SnapshotRegistry(capacity=scfg.ring_capacity)
+        self.server.serving = registry
+        self.serving_engine = PredictionEngine(
+            self.server.task, registry,
+            max_batch=scfg.max_batch,
+            deadline_s=scfg.deadline_ms / 1000.0,
+            tracer=self.tracer)
+        return self.serving_engine
+
+    def close_serving(self) -> None:
+        """Stop the engine's batcher thread (holds jit'd callables —
+        must be joined before interpreter exit, docs/TESTING.md)."""
+        if self.serving_engine is not None:
+            self.serving_engine.close()
+
     # -- membership --------------------------------------------------------
 
     def readmit_worker(self, worker_id: int) -> int:
@@ -215,7 +245,7 @@ class StreamingPSApp:
         as the periodic `[status]` stderr line (`--status_every`)."""
         tr = self.server.tracker
         active = tr.active_workers
-        return {
+        out = {
             "iters": self.server.iterations,
             "clocks": [f"{w}:{tr.tracker[w].vector_clock}"
                        for w in range(self.cfg.num_workers)],
@@ -227,6 +257,15 @@ class StreamingPSApp:
                     fabric_mod.GRADIENTS_TOPIC)},
             "buffers": [b.count for b in self.buffers],
         }
+        if self.serving_engine is not None:
+            s = self.serving_engine.stats()
+            # cumulative count under a *_per_s key: StatusReporter
+            # renders the derived rate since the last heartbeat (QPS)
+            out["predictions_per_s"] = s["requests"]
+            out["serving"] = {
+                "occ": s["occupancy"], "p50_ms": s["p50_ms"],
+                "p99_ms": s["p99_ms"], "stale": s["rejections"]}
+        return out
 
     def _start_status(self, status_every: float | None):
         from kafka_ps_tpu.utils.status import StatusReporter
@@ -683,6 +722,9 @@ class StreamingPSApp:
                 self.workers[w].iterations += r
                 self.server.tracker.tracker[w].vector_clock = clock
                 self.server.tracker.tracker[w].weights_message_sent = True
+            # fused-path publication point: the chunk boundary is the
+            # gate release (all active workers advanced to `clock`)
+            self.server.publish_snapshot()
             self.server.maybe_checkpoint()
             if log_metrics and self.server.test_x is not None:
                 is_eval = clock % self.cfg.eval_every == 0

@@ -15,9 +15,15 @@ design, so their multi-host story is exactly this: a server process
 Wire format, little-endian:
     frame  := <u32 length> <u8 topic> <i64 key> <payload>
     topic  := 1 WEIGHTS | 2 GRADIENTS | 3 INPUT_DATA | 4 HELLO | 5 READY
+              | 6 PING | 7 PONG | 8 CONFIG | 9 PREDICT | 10 PREDICTION
     payload:= serde.to_bytes(message)   (HELLO: <i64 n> <i64 ids[n]>;
-                                         READY: empty)
-`key` is the logical worker id (the Kafka record key, CsvProducer.java:61).
+                                         READY/PING/PONG: empty;
+                                         CONFIG: <f64 ping_interval_s>
+                                                 <i64 run_id>;
+                                         PREDICT / PREDICTION: see the
+                                         encode_/decode_ helpers below)
+`key` is the logical worker id (the Kafka record key, CsvProducer.java:61);
+for PREDICT/PREDICTION it is the client's request id (echoed back).
 
 Delivery properties preserved from the reference fabric: addressed
 per-worker delivery, per-connection FIFO (TCP), asynchronous buffering
@@ -39,10 +45,55 @@ from kafka_ps_tpu.runtime import serde
 
 _FRAME = struct.Struct("<IBq")          # length, topic, key
 (T_WEIGHTS, T_GRADIENTS, T_DATA, T_HELLO, T_READY,
- T_PING, T_PONG, T_CONFIG) = 1, 2, 3, 4, 5, 6, 7, 8
-_TOPIC_NAMES = {T_WEIGHTS: fabric_mod.WEIGHTS_TOPIC,
-                T_GRADIENTS: fabric_mod.GRADIENTS_TOPIC,
-                T_DATA: fabric_mod.INPUT_DATA_TOPIC}
+ T_PING, T_PONG, T_CONFIG, T_PREDICT, T_PREDICTION) = range(1, 11)
+# the full frame-topic table: data topics map to their fabric names,
+# control/serving topics to wire-only names (test_net_framing.py keeps
+# this exhaustive against the T_* constants)
+TOPIC_NAMES = {T_WEIGHTS: fabric_mod.WEIGHTS_TOPIC,
+               T_GRADIENTS: fabric_mod.GRADIENTS_TOPIC,
+               T_DATA: fabric_mod.INPUT_DATA_TOPIC,
+               T_HELLO: "hello", T_READY: "ready",
+               T_PING: "ping", T_PONG: "pong", T_CONFIG: "config",
+               T_PREDICT: "predict", T_PREDICTION: "prediction"}
+
+# -- serving-plane payloads (kafka_ps_tpu/serving/, docs/SERVING.md) -------
+# PREDICT: the feature row plus the request's staleness bound; sentinel
+# -1 encodes "unbounded" (clocks are non-negative, ages positive)
+_PREDICT_HEADER = struct.Struct("<qdq")   # min_clock, max_age_s, n features
+# PREDICTION: status + (label, confidence, snapshot clock, snapshot time)
+_PREDICTION = struct.Struct("<Bqdqd")
+PREDICT_OK, PREDICT_STALE, PREDICT_FAILED = 0, 1, 2
+
+
+def encode_predict_request(x, min_clock: int | None = None,
+                           max_age_s: float | None = None) -> bytes:
+    import numpy as np
+    row = np.asarray(x, dtype=np.float32).reshape(-1)
+    return _PREDICT_HEADER.pack(
+        -1 if min_clock is None else int(min_clock),
+        -1.0 if max_age_s is None else float(max_age_s),
+        row.size) + row.tobytes()
+
+
+def decode_predict_request(payload: bytes):
+    """(features, min_clock | None, max_age_s | None)."""
+    import numpy as np
+    min_clock, max_age_s, n = _PREDICT_HEADER.unpack_from(payload, 0)
+    row = np.frombuffer(payload, dtype=np.float32, count=n,
+                        offset=_PREDICT_HEADER.size)
+    return (row, None if min_clock < 0 else min_clock,
+            None if max_age_s < 0 else max_age_s)
+
+
+def encode_prediction(status: int, label: int = -1, confidence: float = 0.0,
+                      vector_clock: int = -1, wall_time: float = 0.0) -> bytes:
+    return _PREDICTION.pack(status, label, confidence, vector_clock,
+                            wall_time)
+
+
+def decode_prediction(payload: bytes):
+    """(status, label, confidence, vector_clock, wall_time)."""
+    return _PREDICTION.unpack_from(payload, 0)
 
 
 def send_frame(sock: socket.socket, topic: int, key: int,
@@ -140,6 +191,7 @@ class ServerBridge:
         self.on_disconnect = None   # Callable[[list[int]], None]
         self.on_hello = None        # Callable[[list[int]], None]
         self.on_ready = None        # Callable[[int], None]
+        self._serving = None        # PredictionEngine (attach_serving)
         self.dropped_sends = 0      # frames lost to dead connections
         self._hb_interval = heartbeat_interval
         self._hb_timeout = heartbeat_timeout
@@ -175,6 +227,15 @@ class ServerBridge:
         out._tracer = fabric._tracer
         self._fabric = out
         return out
+
+    def attach_serving(self, engine) -> None:
+        """Answer T_PREDICT frames from any connection through a
+        serving.engine.PredictionEngine.  Requests are submitted async —
+        the reader thread never blocks on a batch deadline — and the
+        reply goes out from the engine's batcher thread.  A client need
+        not HELLO: predict-only connections register no worker ids, so
+        the weights/data routing never sees them."""
+        self._serving = engine
 
     def send_data(self, worker: int, features: dict[int, float],
                   label: int) -> bool:
@@ -245,8 +306,9 @@ class ServerBridge:
 
     def _send_raw(self, conn, topic, key, payload: bytes) -> bool:
         # `dropped_sends` is a data-loss diagnostic: a control frame
-        # (PING/CONFIG) hitting a dying connection is not lost data
-        count = topic not in (T_PING, T_CONFIG)
+        # (PING/CONFIG) hitting a dying connection is not lost training
+        # data, and neither is a prediction reply to a vanished client
+        count = topic not in (T_PING, T_CONFIG, T_PREDICTION)
         lock = self._send_lock.get(conn)
         if lock is None:
             self.dropped_sends += count
@@ -334,10 +396,47 @@ class ServerBridge:
                 elif topic == T_GRADIENTS and self._fabric is not None:
                     self._fabric.send(fabric_mod.GRADIENTS_TOPIC, 0,
                                       serde.from_bytes(payload))
+                elif topic == T_PREDICT:
+                    self._handle_predict(conn, key, payload)
         except (ConnectionError, OSError):
             pass
         finally:
             self._cleanup_conn(conn)
+
+    def _handle_predict(self, conn, key: int, payload: bytes) -> None:
+        engine = self._serving
+        if engine is None:
+            # a predict frame on a training-only bridge: explicit
+            # failure beats a silent hang on the client side
+            self._send_raw(conn, T_PREDICTION, key,
+                           encode_prediction(PREDICT_FAILED))
+            return
+        from kafka_ps_tpu.serving.policy import ReadBound, StalenessError
+        try:
+            x, min_clock, max_age_s = decode_predict_request(payload)
+            bound = ReadBound(min_clock=min_clock, max_age_s=max_age_s)
+        except Exception:  # noqa: BLE001 — malformed frame, not our crash
+            self._send_raw(conn, T_PREDICTION, key,
+                           encode_prediction(PREDICT_FAILED))
+            return
+
+        def reply(result, conn=conn, key=key):
+            if isinstance(result, StalenessError):
+                pl = encode_prediction(PREDICT_STALE)
+            elif isinstance(result, BaseException):
+                pl = encode_prediction(PREDICT_FAILED)
+            else:
+                pl = encode_prediction(PREDICT_OK, result.label,
+                                       result.confidence,
+                                       result.vector_clock,
+                                       result.wall_time)
+            self._send_raw(conn, T_PREDICTION, key, pl)
+
+        try:
+            engine.submit(x, bound, reply)
+        except RuntimeError:        # engine already closed (shutdown race)
+            self._send_raw(conn, T_PREDICTION, key,
+                           encode_prediction(PREDICT_FAILED))
 
     def _cleanup_conn(self, conn: socket.socket) -> None:
         """Purge a dead connection's registrations and surface the
@@ -511,3 +610,56 @@ class WorkerBridge:
             self._sock.close()
         except OSError:
             pass
+
+
+class PredictClient:
+    """Remote prediction client for the serving plane (docs/SERVING.md).
+
+    NOT a worker: it sends no HELLO, registers no worker ids, and so
+    never receives weights or data frames — the connection carries only
+    PREDICT/PREDICTION (plus the server's PINGs, answered here to stay
+    alive under heartbeat-timeout enforcement).  Synchronous: one
+    outstanding request per client; run several clients for concurrency.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=5.0)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(timeout)
+        self._send_lock = threading.Lock()
+        self._req = 0
+
+    def predict(self, x, min_clock: int | None = None,
+                max_age_s: float | None = None):
+        """(label, confidence, vector_clock, wall_time) namedtuple;
+        raises serving.policy.StalenessError when the bound rejects."""
+        self._req += 1
+        with self._send_lock:
+            send_frame(self._sock, T_PREDICT, self._req,
+                       encode_predict_request(x, min_clock, max_age_s))
+        while True:
+            frame = recv_frame(self._sock)
+            if frame is None:
+                raise ConnectionError(
+                    "server closed before the prediction arrived")
+            topic, key, payload = frame
+            if topic == T_PING:
+                with self._send_lock:
+                    send_frame(self._sock, T_PONG, 0)
+                continue
+            if topic != T_PREDICTION or key != self._req:
+                continue            # stray control frame (e.g. CONFIG)
+            status, label, conf, clock, wall = decode_prediction(payload)
+            if status == PREDICT_STALE:
+                from kafka_ps_tpu.serving.policy import StalenessError
+                raise StalenessError(
+                    f"server rejected the read bound (min_clock="
+                    f"{min_clock}, max_age_s={max_age_s})",
+                    min_clock=min_clock, max_age_s=max_age_s)
+            if status != PREDICT_OK:
+                raise RuntimeError("prediction failed on the server")
+            from kafka_ps_tpu.serving.engine import Prediction
+            return Prediction(label, conf, clock, wall)
+
+    def close(self) -> None:
+        force_close(self._sock)

@@ -114,6 +114,12 @@ class ServerNode:
         # record on a crash — the very scenario the events exist for
         self.membership_events: list[tuple[int, str, int]] = []
         self.membership_log = None
+        # online serving plane (kafka_ps_tpu/serving/, docs/SERVING.md):
+        # when a SnapshotRegistry is attached, every consistency-gate
+        # release publishes the released theta for readers.  None (the
+        # default) keeps publish_snapshot a no-op — training is
+        # bitwise-identical with serving on or off.
+        self.serving = None
 
     # -- bootstrap (ServerProcessor.java:75-87) ----------------------------
 
@@ -166,6 +172,9 @@ class ServerNode:
         # the bootstrap broadcast is one simultaneous release moment for
         # every consistency model — one notice covers all of it
         self._emit_gang_notice(sorted(released))
+        # first snapshot: the weights the loop starts from (cold start or
+        # checkpoint restore) are servable before any gradient arrives
+        self.publish_snapshot()
 
     def _weights_message(self, vector_clock: int) -> WeightsMessage:
         # device theta is immutable — safe to alias; a host-side theta
@@ -251,6 +260,8 @@ class ServerNode:
             self.send_weights(worker, clock)
         if notify:
             self._emit_gang_notice(release)
+            if release:
+                self.publish_snapshot()
         return release
 
     # -- gang dispatch (runtime/gang.py, docs/GANG_DISPATCH.md) ------------
@@ -273,6 +284,34 @@ class ServerNode:
         for worker, clock in release:
             self.send_weights(worker, clock)
         self._emit_gang_notice(release)
+        if release:
+            self.publish_snapshot()
+
+    # -- serving plane (kafka_ps_tpu/serving/, docs/SERVING.md) ------------
+
+    def serving_clock(self) -> int:
+        """The stable clock a snapshot is stamped with: the slowest
+        ACTIVE worker's vector clock.  Every weights message released at
+        or before this moment carries a clock >= it, so a reader holding
+        a snapshot at clock c knows all workers have incorporated rounds
+        < c — the read-side mirror of the bounded-delay invariant."""
+        active = self.tracker.active_workers
+        if not active:
+            return 0
+        return min(self.tracker.tracker[w].vector_clock for w in active)
+
+    def publish_snapshot(self, theta=None, clock=None) -> None:
+        """Publish (theta, stable clock) to the attached snapshot
+        registry; no-op when serving is off.  Called at every gate
+        release — per-message, gang, fused — plus bootstrap/cold-start.
+        O(1) host-side (the snapshot aliases the immutable device
+        theta), so attaching a registry cannot perturb training."""
+        registry = self.serving
+        if registry is None:
+            return
+        registry.publish(self.theta if theta is None else theta,
+                         self.serving_clock() if clock is None else clock)
+        self.tracer.count("serving.snapshots_published")
 
     # -- the hot path (ServerProcessor.java:143-183) -----------------------
 
@@ -400,6 +439,7 @@ class ServerNode:
         k = len(live)
         eval_positions: list[int] = []
         release_events: list[tuple[int, list[tuple[int, int]]]] = []
+        snap_clocks: dict[int, int] = {}
         for i, m in enumerate(live):
             self.tracker.received_message(m.worker_id, m.vector_clock)
             self.tracer.count("server.gradients_applied")
@@ -412,6 +452,13 @@ class ServerNode:
                 self.tracker.sent_message(w, c)
             if release:
                 release_events.append((i, release))
+                if self.serving is not None:
+                    # stable clock at gate-DECISION time: tracker state
+                    # here matches the per-message path after message i
+                    # (sent_message never moves clocks), so the published
+                    # (theta_i, clock) sequence is bitwise-identical to
+                    # processing the batch one message at a time
+                    snap_clocks[i] = self.serving_clock()
         # releases at the last position see the final theta; earlier
         # ones need their prefix returned from the jit
         prefix_positions = tuple(sorted(
@@ -452,6 +499,12 @@ class ServerNode:
                 for worker, clock in rel:
                     self._send_weights_prepared(worker, clock, theta_i)
                 batch_released.extend(rel)
+                if self.serving is not None:
+                    # gang-path publication point: the prefix theta this
+                    # release observed, at the clock captured when the
+                    # gate opened — one snapshot per release event, same
+                    # as the per-message path
+                    self.publish_snapshot(theta_i, snap_clocks[i])
         # ONE notice for everything this batch released: the release
         # events are simultaneous from the drive loop's point of view
         # (all sends above happened before any worker ran), and the gang

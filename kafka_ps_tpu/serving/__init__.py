@@ -1,0 +1,34 @@
+"""Online serving plane — train/serve split for the streaming PS
+(docs/SERVING.md).
+
+The training loop keeps aggregating deltas while this subsystem answers
+live prediction requests against recent weights:
+
+  * `snapshot.SnapshotRegistry` — immutable (theta, vector_clock,
+    wall_time) snapshots published by the server at every
+    consistency-gate release, hot-swapped lock-free for readers;
+  * `engine.PredictionEngine` — micro-batched, jit'd prediction under a
+    deadline/size cap (the serving-side analogue of gang dispatch);
+  * `policy` — staleness-bounded reads (`min_clock` / `max_age_s`),
+    mirroring the three training consistency models on the read path.
+
+Import discipline: `policy` and `snapshot` are dependency-free (no jax)
+so transport/client code can use them without pulling a backend;
+`engine` defers its jax imports to first prediction.
+"""
+
+from kafka_ps_tpu.serving.policy import (EVENTUAL_READ, ReadBound,
+                                         StalenessError)
+from kafka_ps_tpu.serving.snapshot import Snapshot, SnapshotRegistry
+
+__all__ = ["EVENTUAL_READ", "ReadBound", "StalenessError", "Snapshot",
+           "SnapshotRegistry", "PredictionEngine", "Prediction"]
+
+
+def __getattr__(name):
+    # engine pulls in numpy/jax-adjacent machinery; load it only when a
+    # caller actually serves predictions
+    if name in ("PredictionEngine", "Prediction"):
+        from kafka_ps_tpu.serving import engine
+        return getattr(engine, name)
+    raise AttributeError(name)

@@ -1,0 +1,219 @@
+"""Micro-batching prediction engine — gang dispatch for the read path.
+
+Requests queue up; a single batcher thread coalesces them until either
+`max_batch` rows are waiting or `deadline_s` has elapsed since the first
+row arrived, then runs ONE jit'd forward pass over a padded fixed-shape
+batch. The amortization argument is identical to training-side gang
+dispatch (docs/GANG_DISPATCH.md): dispatch overhead is per-XLA-call, so
+k requests per call cost ~1/k of the per-request dispatch tax. The
+fixed (max_batch, F) shape means exactly one compile per model family.
+
+Each micro-batch resolves the snapshot registry ONCE — all rows in a
+batch are answered from the same (theta, clock) pair, and each row's
+read bound is checked against that snapshot (the registry only ever
+serves its newest snapshot, so a bound the newest fails no snapshot
+passes; see serving/policy.py).
+
+jax imports are deferred to the first dispatch so thin clients can
+import this module (for the Prediction type) without a backend.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, NamedTuple
+
+import numpy as np
+
+from kafka_ps_tpu.serving import policy
+from kafka_ps_tpu.serving.snapshot import SnapshotRegistry
+from kafka_ps_tpu.utils.trace import NULL_TRACER, LatencyRecorder
+
+
+class Prediction(NamedTuple):
+    label: int             # argmax class
+    confidence: float      # softmax mass on the argmax class
+    vector_clock: int      # clock of the snapshot that answered
+    wall_time: float       # publication time of that snapshot
+
+
+class _Request(NamedTuple):
+    x: np.ndarray
+    bound: policy.ReadBound | None
+    callback: Callable     # called with Prediction or an Exception
+    t0: float              # monotonic enqueue time (latency accounting)
+
+
+_SENTINEL = object()
+
+
+class PredictionEngine:
+    """Deadline/size-capped micro-batcher over a SnapshotRegistry."""
+
+    def __init__(self, task, registry: SnapshotRegistry, *,
+                 max_batch: int = 16, deadline_s: float = 0.002,
+                 tracer=None, now=time.time):
+        self.task = task
+        self.registry = registry
+        self.max_batch = max(1, int(max_batch))
+        self.deadline_s = max(0.0, float(deadline_s))
+        self.tracer = tracer or NULL_TRACER
+        self._now = now
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self.latency = LatencyRecorder()
+        # cumulative counters; status() exposes requests as a *_per_s key
+        self.requests = 0
+        self.batches = 0          # device dispatches (== jit calls)
+        self.batched_rows = 0     # rows that made it into a dispatch
+        self.rejections = 0       # staleness rejections
+        self.errors = 0
+        self._predict = None      # jit'd forward, built on first dispatch
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name="kps-serve-batch", daemon=True)
+        self._thread.start()
+
+    # -- request entry points ----------------------------------------------
+    def submit(self, x, bound: policy.ReadBound | None = None,
+               callback: Callable = lambda result: None) -> None:
+        """Async predict: callback fires on the batcher thread with a
+        Prediction, or with the StalenessError/Exception that killed the
+        request. Never blocks the caller."""
+        if self._closed:
+            raise RuntimeError("prediction engine is closed")
+        row = np.asarray(x, dtype=np.float32).reshape(-1)
+        self._q.put(_Request(row, bound, callback, time.monotonic()))
+
+    def predict(self, x, bound: policy.ReadBound | None = None, *,
+                min_clock: int | None = None, max_age_s: float | None = None,
+                timeout: float = 30.0) -> Prediction:
+        """Sync predict; raises StalenessError if the bound rejects."""
+        if bound is None and (min_clock is not None or max_age_s is not None):
+            bound = policy.ReadBound(min_clock=min_clock, max_age_s=max_age_s)
+        done = threading.Event()
+        box: list = []
+
+        def _cb(result):
+            box.append(result)
+            done.set()
+
+        self.submit(x, bound, _cb)
+        if not done.wait(timeout):
+            raise TimeoutError("prediction timed out")
+        result = box[0]
+        if isinstance(result, BaseException):
+            raise result
+        return result
+
+    # -- batcher loop -------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            first = self._q.get()
+            if first is _SENTINEL:
+                return
+            batch = [first]
+            deadline = time.monotonic() + self.deadline_s
+            stop = False
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _SENTINEL:
+                    stop = True
+                    break
+                batch.append(nxt)
+            self._serve(batch)
+            if stop:
+                return
+
+    def _serve(self, batch: list[_Request]) -> None:
+        self.requests += len(batch)
+        # one snapshot resolution per micro-batch: every row is answered
+        # from the same hot-swapped (theta, clock) pair
+        snap = self.registry.latest
+        now = self._now()
+        live: list[_Request] = []
+        for req in batch:
+            try:
+                policy.check(snap, req.bound, now)
+            except policy.StalenessError as err:
+                self.rejections += 1
+                self.tracer.count("serving.staleness_rejections")
+                self._finish(req, err)
+                continue
+            live.append(req)
+        if not live:
+            return
+        try:
+            labels, confs = self._dispatch(snap.theta, live)
+        except Exception as err:  # noqa: BLE001 — fail the rows, not the loop
+            self.errors += 1
+            for req in live:
+                self._finish(req, err)
+            return
+        self.batches += 1
+        self.batched_rows += len(live)
+        self.tracer.count("serving.batch_dispatches")
+        for i, req in enumerate(live):
+            self._finish(req, Prediction(int(labels[i]), float(confs[i]),
+                                         snap.vector_clock, snap.wall_time))
+
+    def _dispatch(self, theta, live: list[_Request]):
+        fn = self._predict_fn()
+        xs = np.zeros((self.max_batch, self.task.cfg.num_features),
+                      dtype=np.float32)
+        for i, req in enumerate(live):
+            xs[i, :req.x.size] = req.x[:xs.shape[1]]
+        with self.tracer.span("serving.predict", rows=len(live)):
+            labels, confs = fn(theta, xs)
+            # block so latency samples measure real service time
+            labels = np.asarray(labels)
+            confs = np.asarray(confs)
+        return labels, confs
+
+    def _predict_fn(self):
+        if self._predict is None:
+            import jax
+            import jax.numpy as jnp
+
+            task = self.task
+
+            def _forward(theta, x):
+                lg = task.predict_logits(theta, x)
+                probs = jax.nn.softmax(lg, axis=-1)
+                return jnp.argmax(lg, axis=-1), jnp.max(probs, axis=-1)
+
+            self._predict = jax.jit(_forward)
+        return self._predict
+
+    def _finish(self, req: _Request, result) -> None:
+        self.latency.record(time.monotonic() - req.t0)
+        try:
+            req.callback(result)
+        except Exception:  # noqa: BLE001 — a bad callback must not stall serving
+            self.tracer.count("serving.callback_errors")
+
+    # -- ops surface --------------------------------------------------------
+    def stats(self) -> dict:
+        occupancy = (round(self.batched_rows / self.batches, 2)
+                     if self.batches else 0.0)
+        out = {"requests": self.requests, "batches": self.batches,
+               "occupancy": occupancy, "rejections": self.rejections,
+               "errors": self.errors}
+        out.update(self.latency.percentiles_ms(50, 99))
+        return out
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop the batcher thread. Must run before interpreter exit —
+        the thread holds jit'd callables (native code)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(_SENTINEL)
+        self._thread.join(timeout)

@@ -1,0 +1,106 @@
+"""Immutable model snapshots with lock-free hot swap.
+
+The server publishes a snapshot at every consistency-gate release (see
+ServerNode.publish_snapshot): the exact theta the released workers were
+sent, stamped with the stable vector clock at that moment. Snapshots
+alias the server's device array — safe because ServerNode only ever
+*replaces* theta, never mutates it in place.
+
+Readers (the prediction engine, any thread calling `latest`) take no
+lock: publication builds the complete Snapshot first and then swaps one
+reference, which is atomic under the GIL. A reader therefore always
+sees a fully-formed (theta, clock, time) triple — never a torn mix of
+two publications. The publisher-side lock only serialises concurrent
+publishers (threaded runtime: drive threads + fused loop).
+
+A bounded ring keeps the newest `capacity` snapshots for exact-clock
+audit reads (`at_clock`); older ones fall off and become unreachable.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import NamedTuple
+
+from kafka_ps_tpu.serving import policy
+
+
+class Snapshot(NamedTuple):
+    theta: object          # device or host array; immutable by contract
+    vector_clock: int      # stable clock: min active-worker clock at publish
+    wall_time: float       # publication time (registry's clock)
+    seq: int               # monotonically increasing publication number
+
+
+class SnapshotRegistry:
+    """Bounded ring of published snapshots with a lock-free `latest`."""
+
+    def __init__(self, capacity: int = 8, now=time.time):
+        self._ring: collections.deque[Snapshot] = collections.deque(
+            maxlen=max(1, int(capacity)))
+        self._latest: Snapshot | None = None
+        self._seq = 0
+        self._now = now
+        self._publish_lock = threading.Lock()
+
+    def publish(self, theta, vector_clock: int,
+                wall_time: float | None = None) -> Snapshot:
+        with self._publish_lock:
+            self._seq += 1
+            snap = Snapshot(
+                theta, int(vector_clock),
+                self._now() if wall_time is None else float(wall_time),
+                self._seq)
+            self._ring.append(snap)
+            # single atomic reference swap — this is the hot-swap point;
+            # readers of `latest` never block on the publish lock
+            self._latest = snap
+        return snap
+
+    @property
+    def latest(self) -> Snapshot | None:
+        return self._latest
+
+    def snapshots(self) -> tuple[Snapshot, ...]:
+        """The retained ring, oldest first."""
+        return tuple(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def get(self, bound: policy.ReadBound | None = None, *,
+            min_clock: int | None = None, max_age_s: float | None = None,
+            at_clock: int | None = None, now: float | None = None) -> Snapshot:
+        """Newest snapshot satisfying the bound, or raise StalenessError.
+
+        Accepts either a ReadBound or the individual fields (not both).
+        """
+        if bound is None:
+            bound = policy.ReadBound(min_clock=min_clock,
+                                     max_age_s=max_age_s, at_clock=at_clock)
+        elif min_clock is not None or max_age_s is not None \
+                or at_clock is not None:
+            raise ValueError("pass either a ReadBound or keyword fields")
+        now = self._now() if now is None else now
+        if bound.at_clock is not None:
+            snap = self._find_clock(bound.at_clock)
+        else:
+            snap = self._latest
+        policy.check(snap, bound, now)
+        return snap
+
+    def _find_clock(self, clock: int) -> Snapshot | None:
+        # newest-first so duplicate clocks (e.g. the cold-start publish
+        # followed by the first gate release at the same clock) resolve
+        # to the most recent publication
+        for snap in reversed(tuple(self._ring)):
+            if snap.vector_clock == clock:
+                return snap
+        raise policy.StalenessError(
+            f"no retained snapshot at clock {clock} "
+            f"(ring keeps the newest {self._ring.maxlen})",
+            min_clock=clock,
+            have_clock=None if self._latest is None
+            else self._latest.vector_clock)

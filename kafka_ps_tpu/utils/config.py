@@ -64,6 +64,19 @@ class StreamConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Online serving plane (kafka_ps_tpu/serving/, docs/SERVING.md):
+    snapshot ring + micro-batching prediction engine.  `--serve` flag
+    group in cli/run.py."""
+
+    enabled: bool = False
+    port: int | None = None       # socket endpoint; None = in-process only
+    max_batch: int = 16           # micro-batch size cap (one jit shape)
+    deadline_ms: float = 2.0      # max wait to fill a micro-batch
+    ring_capacity: int = 8        # retained snapshots (at_clock reads)
+
+
+@dataclasses.dataclass(frozen=True)
 class PSConfig:
     """Top-level parameter-server configuration (BaseKafkaApp.java:25,
     ServerProcessor.java:36,45-49)."""
@@ -92,6 +105,11 @@ class PSConfig:
     # path.  In-process fabrics only — socket mode forces it off (the
     # wire protocol has no gang notice frame).
     use_gang: bool = True
+    # Online serving plane (kafka_ps_tpu/serving/): disabled by default —
+    # attaching it never perturbs training (snapshots alias the
+    # immutable device theta), but the engine thread only exists when
+    # asked for.
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
 
     @property
     def server_lr(self) -> float:

@@ -26,9 +26,13 @@ class StatusReporter:
     """Prints `source()` every `interval` seconds on its own thread.
 
     `source` returns a dict; an `iters` key gets a derived rate
-    (+N/s since the previous line).  The thread only formats and
-    prints host-side state — still joined on stop(), per the teardown
-    discipline (docs/TESTING.md)."""
+    (+N/s since the previous line), and ANY key suffixed `_per_s`
+    (top-level or nested one dict deep) is treated as a cumulative
+    count and rendered as the rate since the previous line ("--" until
+    a baseline exists) — how the serving plane's QPS rides the same
+    heartbeat.  The thread only formats and prints host-side state —
+    still joined on stop(), per the teardown discipline
+    (docs/TESTING.md)."""
 
     def __init__(self, interval: float, source: Callable[[], dict],
                  out=None, clock=time.monotonic):
@@ -38,8 +42,9 @@ class StatusReporter:
         self._clock = clock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._last_iters: int | None = None
-        self._last_ts: float | None = None
+        # per-key (last value, last timestamp) for every derived-rate
+        # key — `iters` and the `*_per_s` family share the mechanism
+        self._last_counts: dict[str, tuple[float, float]] = {}
 
     def start(self) -> "StatusReporter":
         if self.interval and self.interval > 0 and self._thread is None:
@@ -62,20 +67,36 @@ class StatusReporter:
         parts = []
         for k, v in fields.items():
             if k == "iters" and isinstance(v, (int, float)):
-                rate = ""
-                if self._last_iters is not None and now > self._last_ts:
-                    per_s = (v - self._last_iters) / (now - self._last_ts)
-                    rate = f" (+{per_s:.1f}/s)"
-                self._last_iters, self._last_ts = v, now
+                per_s = self._rate("iters", v, now)
+                rate = "" if per_s is None else f" (+{per_s:.1f}/s)"
                 parts.append(f"iters={v}{rate}")
+            elif k.endswith("_per_s") and isinstance(v, (int, float)):
+                parts.append(f"{k}={self._fmt_rate(k, v, now)}")
             elif isinstance(v, dict):
-                inner = " ".join(f"{ik}={iv}" for ik, iv in v.items())
+                inner = " ".join(
+                    f"{ik}={self._fmt_rate(f'{k}.{ik}', iv, now)}"
+                    if ik.endswith("_per_s") and isinstance(iv, (int, float))
+                    else f"{ik}={iv}"
+                    for ik, iv in v.items())
                 parts.append(f"{k} {inner}")
             elif isinstance(v, (list, tuple)):
                 parts.append(f"{k}=" + ",".join(str(i) for i in v))
             else:
                 parts.append(f"{k}={v}")
         print("[status] " + " ".join(parts), file=self.out, flush=True)
+
+    def _rate(self, key: str, value: float, now: float) -> float | None:
+        """Derived rate for a cumulative count since its previous
+        sample; None until a baseline exists (first line)."""
+        prev = self._last_counts.get(key)
+        self._last_counts[key] = (value, now)
+        if prev is None or now <= prev[1]:
+            return None
+        return (value - prev[0]) / (now - prev[1])
+
+    def _fmt_rate(self, key: str, value: float, now: float) -> str:
+        per_s = self._rate(key, value, now)
+        return "--" if per_s is None else f"{per_s:.1f}"
 
     def stop(self) -> None:
         self._stop.set()

@@ -8,7 +8,76 @@
 # tiny serial run with coalescing on vs off, asserting identical final
 # theta (bitwise) and a strictly lower device-dispatch count
 # (docs/GANG_DISPATCH.md).
+#
+# `scripts/tier1.sh --serve` runs the serving-plane smoke leg: train a
+# tiny model with serving enabled, predict in-process AND over the
+# socket (PredictClient), and assert the staleness rejection path fires
+# (docs/SERVING.md).
 set -o pipefail
+
+if [[ "${1:-}" == "--serve" ]]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+from kafka_ps_tpu.runtime import net
+from kafka_ps_tpu.runtime.app import StreamingPSApp
+from kafka_ps_tpu.serving import StalenessError
+from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig, PSConfig,
+                                       ServingConfig, StreamConfig)
+
+cfg = PSConfig(num_workers=4, consistency_model=0,
+               model=ModelConfig(num_features=8, num_classes=2,
+                                 local_learning_rate=0.5),
+               buffer=BufferConfig(min_size=8, max_size=32),
+               stream=StreamConfig(time_per_event_ms=1.0),
+               serving=ServingConfig(enabled=True))
+rng = np.random.default_rng(0)
+x = rng.normal(size=(128, 8)).astype(np.float32)
+y = (x[:, 0] > 0).astype(np.int32) + 1
+app = StreamingPSApp(cfg, test_x=x, test_y=y)
+engine = app.enable_serving()
+for i in range(128):
+    app.buffers[i % 4].add({j: float(x[i, j]) for j in range(8)},
+                           int(y[i]))
+app.run_serial(24)
+
+# in-process prediction against the trained snapshot
+pred = engine.predict(x[0])
+assert pred.vector_clock > 0, pred
+ref = app.server.task.predict_logits(app.server.theta, x[:1])
+assert pred.label == int(np.argmax(np.asarray(ref)[0])), pred
+
+# the staleness rejection path must fire for an unsatisfiable bound
+try:
+    engine.predict(x[0], min_clock=10**9)
+except StalenessError:
+    pass
+else:
+    raise AssertionError("unsatisfiable min_clock was served")
+assert engine.rejections >= 1, engine.stats()
+
+# the same predictions over the wire (cli/run.py --serve --serve_port)
+bridge = net.ServerBridge(port=0, run_id=app.server.run_id)
+bridge.attach_serving(engine)
+client = net.PredictClient("127.0.0.1", bridge.port)
+try:
+    remote = client.predict(x[0])
+    assert remote.label == pred.label, (remote, pred)
+    try:
+        client.predict(x[0], min_clock=10**9)
+    except StalenessError:
+        pass
+    else:
+        raise AssertionError("remote staleness bound was served")
+finally:
+    client.close()
+    bridge.close()
+    s = engine.stats()
+    app.close_serving()
+print(f"SERVE_SMOKE_OK requests={s['requests']} batches={s['batches']} "
+      f"rejections={s['rejections']}")
+EOF
+    exit $?
+fi
 
 if [[ "${1:-}" == "--gang" ]]; then
     timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'

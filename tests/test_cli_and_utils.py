@@ -321,6 +321,43 @@ def test_status_reporter_formats_and_rates():
     assert "active=2/2" in lines[1]
 
 
+def test_status_reporter_derived_per_s_rates():
+    """Any `*_per_s` key — top-level or one dict deep — is a cumulative
+    count rendered as the rate since the previous line ("--" until a
+    baseline exists): how the serving plane's QPS rides the heartbeat
+    (docs/SERVING.md) without a schema change per counter."""
+    import io
+
+    from kafka_ps_tpu.utils.status import StatusReporter
+
+    samples = iter([
+        {"iters": 0, "predictions_per_s": 0,
+         "serving": {"occ": 1.0, "rejections_per_s": 0}},
+        {"iters": 10, "predictions_per_s": 300,
+         "serving": {"occ": 3.5, "rejections_per_s": 4}},
+        {"iters": 20, "predictions_per_s": 450,
+         "serving": {"occ": 2.0, "rejections_per_s": 4}},
+    ])
+    ticks = iter([0.0, 2.0, 4.0])
+    out = io.StringIO()
+    rep = StatusReporter(0.0, lambda: next(samples), out=out,
+                         clock=lambda: next(ticks))
+    for _ in range(3):
+        rep.emit()
+    lines = out.getvalue().splitlines()
+    # first line: no baseline yet for any derived key
+    assert "predictions_per_s=--" in lines[0]
+    assert "serving occ=1.0 rejections_per_s=--" in lines[0]
+    # 300 predictions over 2 s; 4 rejections over the same window
+    assert "predictions_per_s=150.0" in lines[1]
+    assert "rejections_per_s=2.0" in lines[1]
+    # each key rates against ITS OWN previous sample, not the first
+    assert "predictions_per_s=75.0" in lines[2]
+    assert "rejections_per_s=0.0" in lines[2]
+    # non-rate fields pass through untouched
+    assert "occ=3.5" in lines[1] and "occ=2.0" in lines[2]
+
+
 def test_status_reporter_survives_source_errors():
     import io
 

@@ -215,6 +215,59 @@ def test_corrupted_tail_is_discarded_and_regenerated(tmp_path):
                                   np.asarray(base.server.theta))
 
 
+def test_cold_start_serving_publishes_recovered_theta(tmp_path):
+    """Serve-from-checkpoint cold start (docs/SERVING.md): a restarted
+    `--durable-log --serve` process must make its FIRST snapshot the
+    restored checkpoint theta (bitwise) at the restored stable clock,
+    then — when the log's newest RELEASED weights are strictly ahead —
+    publish that record too, so readers immediately see everything the
+    dead process had promised.  Component-level mirror of the
+    cli/run.py --serve cold-start block."""
+    x, y = make_dataset()
+    log_dir = str(tmp_path / "wal")
+    ck_path = str(tmp_path / "ck.npz")
+    app1 = build_app(fabric=DurableFabric(log_dir, LogConfig(fsync="none")))
+    app1.server.checkpoint_path = ck_path
+    app1.server.checkpoint_every = 16
+    app1.server.checkpoint_buffers = app1.buffers
+    fill(app1, x, y)
+    app1.run_serial(max_server_iterations=24)
+    # SIGKILL simulation: abandoned — no close, no final save
+
+    app2 = build_app(fabric=DurableFabric(log_dir, LogConfig(fsync="none")))
+    app2.server.checkpoint_path = ck_path
+    app2.server.checkpoint_buffers = app2.buffers
+    assert ckpt.maybe_restore(ck_path, app2.server, buffers=app2.buffers)
+    theta_restored = np.asarray(app2.server.theta).copy()
+    app2.recover_durable()
+    assert np.asarray(app2.server.theta).tobytes() == \
+        theta_restored.tobytes(), "recover_durable must not touch theta"
+
+    # the CLI cold-start sequence (cli/run.py --serve, durable branch)
+    engine = app2.enable_serving()
+    app2.server.publish_snapshot()
+    stable = app2.server.serving_clock()
+    latest = app2.fabric.latest_logged_weights()
+    assert latest is not None            # bootstrap broadcast was logged
+    if latest.vector_clock > stable:
+        app2.server.publish_snapshot(latest.values, latest.vector_clock)
+    try:
+        reg = app2.server.serving
+        first = reg.snapshots()[0]
+        assert np.asarray(first.theta).tobytes() == theta_restored.tobytes()
+        assert first.vector_clock == stable
+        if latest.vector_clock > stable:
+            # the fresher released record became the newest snapshot
+            assert reg.latest.vector_clock == latest.vector_clock
+            assert np.asarray(reg.latest.theta).tobytes() == \
+                np.asarray(latest.values).tobytes()
+        # a bounded read against the recovered state serves immediately
+        pred = engine.predict(x[0], min_clock=stable)
+        assert pred.vector_clock >= stable
+    finally:
+        app2.close_serving()
+
+
 def test_recover_is_once_only(tmp_path):
     f = DurableFabric(str(tmp_path / "wal"), LogConfig(fsync="none"))
     f.recover()
@@ -286,14 +339,19 @@ def test_sigkill_restart_matches_uninterrupted_run(tmp_path):
         crash_iters = int(z["iterations"])
     assert crash_iters < 160, "job finished before the kill — no crash to test"
 
-    # restart: restore + replay + run to completion
-    r2 = subprocess.run(cmd("ck.npz", durable), cwd=tmp_path / "crash",
+    # restart: restore + replay + run to completion — with the serving
+    # plane on (--serve over a socket), which must neither perturb the
+    # replayed training (theta equality below) nor fail to come up
+    r2 = subprocess.run(cmd("ck.npz", durable + ["--serve",
+                                                 "--serve_port", "0"]),
+                        cwd=tmp_path / "crash",
                         env=_env(), capture_output=True, text=True,
                         timeout=300)
     assert r2.returncode == 0, r2.stderr[-3000:]
     assert f"restored checkpoint at iteration {crash_iters}" in r2.stdout, \
         r2.stdout[-2000:]
     assert "durable-log replay" in r2.stdout, r2.stdout[-2000:]
+    assert "serving on port" in r2.stderr, r2.stderr[-2000:]
 
     with np.load(ck) as z:
         assert int(z["iterations"]) >= 160

@@ -184,6 +184,130 @@ def test_config_frame_floors_too_small_heartbeat_timeout(capsys):
     worker.close(), bridge.close()
 
 
+# -- frame-topic table + control-frame round-trips ---------------------------
+
+
+def test_topic_name_table_is_exhaustive():
+    """Every T_* wire constant must have a TOPIC_NAMES entry (and
+    nothing else): a new frame type without a name breaks tracing and
+    this table's role as the wire-format registry."""
+    constants = {v for k, v in vars(net).items()
+                 if k.startswith("T_") and isinstance(v, int)}
+    assert set(net.TOPIC_NAMES) == constants
+    assert len(net.TOPIC_NAMES) == len(constants)
+    assert all(isinstance(n, str) and n for n in net.TOPIC_NAMES.values())
+
+
+@pytest.mark.parametrize("topic", [net.T_PING, net.T_PONG])
+def test_control_frame_roundtrip_empty_payload(topic):
+    a, b = _pair()
+    net.send_frame(a, topic, 0)
+    assert net.recv_frame(b) == (topic, 0, b"")
+    a.close(), b.close()
+
+
+def test_config_frame_roundtrip():
+    a, b = _pair()
+    payload = struct.pack("<dq", 0.25, 42)
+    net.send_frame(a, net.T_CONFIG, 0, payload)
+    topic, key, got = net.recv_frame(b)
+    assert topic == net.T_CONFIG
+    interval, run_id = struct.unpack("<dq", got)
+    assert (interval, run_id) == (0.25, 42)
+    a.close(), b.close()
+
+
+# -- serving-plane payload codecs (docs/SERVING.md) --------------------------
+
+
+def test_predict_request_codec_roundtrip():
+    x = np.arange(6, dtype=np.float32)
+    row, min_clock, max_age = net.decode_predict_request(
+        net.encode_predict_request(x, min_clock=7, max_age_s=1.5))
+    np.testing.assert_array_equal(row, x)
+    assert (min_clock, max_age) == (7, 1.5)
+    # unbounded request: both sentinels decode back to None
+    row, min_clock, max_age = net.decode_predict_request(
+        net.encode_predict_request(x))
+    np.testing.assert_array_equal(row, x)
+    assert (min_clock, max_age) == (None, None)
+
+
+def test_prediction_codec_roundtrip():
+    got = net.decode_prediction(net.encode_prediction(
+        net.PREDICT_OK, label=3, confidence=0.875, vector_clock=11,
+        wall_time=123.5))
+    assert got == (net.PREDICT_OK, 3, 0.875, 11, 123.5)
+    status, *_ = net.decode_prediction(
+        net.encode_prediction(net.PREDICT_STALE))
+    assert status == net.PREDICT_STALE
+
+
+def _serving_engine():
+    """Tiny trained-ish logreg engine over a one-snapshot registry."""
+    import jax.numpy as jnp
+
+    from kafka_ps_tpu.models.task import get_task
+    from kafka_ps_tpu.serving.engine import PredictionEngine
+    from kafka_ps_tpu.serving.snapshot import SnapshotRegistry
+    from kafka_ps_tpu.utils.config import ModelConfig
+
+    cfg = ModelConfig(num_features=4, num_classes=2)
+    task = get_task("logreg", cfg)
+    rng = np.random.default_rng(5)
+    theta = jnp.asarray(rng.normal(size=task.num_params)
+                        .astype(np.float32))
+    registry = SnapshotRegistry()
+    registry.publish(theta, vector_clock=9)
+    return PredictionEngine(task, registry), cfg
+
+
+def test_predict_client_end_to_end():
+    from kafka_ps_tpu.serving import StalenessError
+
+    engine, cfg = _serving_engine()
+    bridge = net.ServerBridge()
+    bridge.attach_serving(engine)
+    client = net.PredictClient("127.0.0.1", bridge.port)
+    try:
+        x = np.ones(cfg.num_features, np.float32)
+        local = engine.predict(x)
+        remote = client.predict(x)
+        assert remote.label == local.label
+        assert remote.confidence == pytest.approx(local.confidence)
+        assert remote.vector_clock == 9
+        # satisfied bound serves; unsatisfiable bound raises client-side
+        assert client.predict(x, min_clock=9).vector_clock == 9
+        with pytest.raises(StalenessError):
+            client.predict(x, min_clock=10)
+    finally:
+        client.close()
+        bridge.close()
+        engine.close()
+    assert bridge.dropped_sends == 0
+
+
+def test_predict_without_engine_fails_cleanly():
+    bridge = net.ServerBridge()             # attach_serving never called
+    client = net.PredictClient("127.0.0.1", bridge.port)
+    try:
+        with pytest.raises(RuntimeError, match="prediction failed"):
+            client.predict(np.zeros(4, np.float32))
+    finally:
+        client.close()
+        bridge.close()
+
+
+def test_prediction_failures_not_counted_as_dropped_sends():
+    """T_PREDICTION rides the same exemption as PING/CONFIG: a client
+    that hung up mid-request must not inflate the data-loss counter."""
+    bridge = net.ServerBridge()
+    dead = object()                     # never registered -> no lock
+    assert bridge._send_raw(dead, net.T_PREDICTION, 0, b"") is False
+    assert bridge.dropped_sends == 0
+    bridge.close()
+
+
 def test_config_frame_disables_timeout_when_server_never_pings():
     """A quiet-but-alive server (no heartbeat_interval) must not be
     misread as dead no matter the worker's timeout flag."""

@@ -1,0 +1,320 @@
+"""Online serving plane (kafka_ps_tpu/serving/, docs/SERVING.md).
+
+Three contracts under test:
+
+  * snapshot registry — lock-free hot swap is ATOMIC (a reader never
+    observes a half-published snapshot), the ring keeps the newest N,
+    and the snapshot sequence a gang-coalesced run publishes is bitwise
+    the sequence the per-message path publishes (clock and theta);
+  * staleness policy — min_clock / max_age_s bounds either serve the
+    newest satisfying snapshot or raise StalenessError, never silently
+    degrade;
+  * the engine + trainer — micro-batched predictions are correct under
+    concurrent load, and enabling serving does not perturb training:
+    final theta and metric CSV rows are bitwise identical (modulo
+    timestamps) to a run without it, for all three consistency models.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.runtime.app import StreamingPSApp
+from kafka_ps_tpu.serving import (EVENTUAL_READ, ReadBound, Snapshot,
+                                  SnapshotRegistry, StalenessError)
+from kafka_ps_tpu.utils.config import (BufferConfig, EVENTUAL, ModelConfig,
+                                       PSConfig, ServingConfig, StreamConfig)
+
+
+def serve_cfg(consistency=0, use_gang=True, **serving_kw):
+    return PSConfig(
+        num_workers=4,
+        consistency_model=consistency,
+        model=ModelConfig(num_features=8, num_classes=2,
+                          local_learning_rate=0.5, hidden_dim=16),
+        buffer=BufferConfig(min_size=8, max_size=32),
+        stream=StreamConfig(time_per_event_ms=1.0),
+        use_gang=use_gang,
+        serving=ServingConfig(enabled=True, **serving_kw),
+    )
+
+
+def make_dataset(n=256, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(1, 3, size=n).astype(np.int32)
+    centers = np.array([[0.0] * f, [2.5] * f, [-2.5] * f], np.float32)
+    x = (centers[y] + rng.normal(scale=0.5, size=(n, f))).astype(np.float32)
+    return x, y
+
+
+def build_app(cfg, **kw):
+    x, y = make_dataset()
+    app = StreamingPSApp(cfg, test_x=x, test_y=y, **kw)
+    for i in range(len(x)):
+        app.data_sink(i % cfg.num_workers,
+                      {j: float(v) for j, v in enumerate(x[i]) if v != 0},
+                      int(y[i]))
+    return app, x, y
+
+
+def strip_ts(rows):
+    return [r.split(";", 1)[1] for r in rows]
+
+
+# -- registry: hot swap, ring, bounds ----------------------------------------
+
+
+def test_hot_swap_atomic_under_threads():
+    """Readers racing a publisher must only ever see fully-formed
+    snapshots: every theta internally consistent (all elements equal
+    its seq marker) and seq/clock monotone per reader."""
+    reg = SnapshotRegistry(capacity=4)
+    reg.publish(np.full(4, 0.0), vector_clock=0)
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        last_seq = -1
+        while not stop.is_set():
+            s = reg.latest
+            th = np.asarray(s.theta)
+            if not (th == th[0]).all():
+                errors.append(f"torn theta {th}")
+                return
+            if th[0] != float(s.vector_clock):
+                errors.append(f"theta/clock mismatch {th[0]} {s}")
+                return
+            if s.seq < last_seq:
+                errors.append(f"seq went backwards {s.seq} < {last_seq}")
+                return
+            last_seq = s.seq
+
+    readers = [threading.Thread(target=reader) for _ in range(4)]
+    for t in readers:
+        t.start()
+    for clock in range(1, 500):
+        reg.publish(np.full(4, float(clock)), vector_clock=clock)
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not errors, errors
+    assert reg.latest.vector_clock == 499
+
+
+def test_ring_evicts_oldest_keeps_newest():
+    reg = SnapshotRegistry(capacity=3)
+    for clock in range(6):
+        reg.publish(np.full(2, float(clock)), vector_clock=clock)
+    assert len(reg) == 3
+    assert [s.vector_clock for s in reg.snapshots()] == [3, 4, 5]
+    assert reg.latest.vector_clock == 5
+    # an exact-clock read inside the ring hits; an evicted clock raises
+    assert reg.get(at_clock=4).vector_clock == 4
+    with pytest.raises(StalenessError):
+        reg.get(at_clock=1)
+
+
+def test_staleness_bounds_with_injected_clock():
+    now = {"t": 100.0}
+    reg = SnapshotRegistry(capacity=4, now=lambda: now["t"])
+    reg.publish(np.zeros(2), vector_clock=5)        # wall_time = 100.0
+
+    assert reg.get(EVENTUAL_READ).vector_clock == 5
+    assert reg.get(min_clock=5).vector_clock == 5
+    with pytest.raises(StalenessError) as ei:
+        reg.get(min_clock=6)
+    assert ei.value.min_clock == 6 and ei.value.have_clock == 5
+
+    now["t"] = 103.0
+    assert reg.get(max_age_s=5.0).vector_clock == 5
+    with pytest.raises(StalenessError) as ei:
+        reg.get(max_age_s=2.0)
+    assert ei.value.max_age_s == 2.0 and ei.value.have_age_s == 3.0
+
+    # empty registry: every bound (even none) is a staleness error
+    empty = SnapshotRegistry()
+    with pytest.raises(StalenessError):
+        empty.get()
+
+
+def test_read_bound_validation():
+    with pytest.raises(ValueError):
+        SnapshotRegistry().get(ReadBound(min_clock=1), min_clock=2)
+    assert EVENTUAL_READ.unbounded
+    assert not ReadBound(min_clock=1).unbounded
+    assert isinstance(Snapshot(np.zeros(1), 0, 0.0, 0), tuple)
+
+
+# -- publication: gang path mirrors the per-message path ---------------------
+
+
+@pytest.mark.parametrize("consistency", [0, 3, EVENTUAL])
+def test_snapshot_sequence_gang_bitwise(consistency):
+    """Gate releases coalesced into one gang dispatch must publish the
+    SAME snapshot sequence (clock and theta, bitwise) the per-message
+    path publishes — a mid-gang reader sees exactly the post-release
+    theta it would have seen message by message."""
+    seqs = {}
+    for gang in (True, False):
+        app, _, _ = build_app(serve_cfg(consistency, use_gang=gang))
+        reg = SnapshotRegistry(capacity=1024)
+        app.server.serving = reg        # registry only: no engine needed
+        app.run_serial(max_server_iterations=40)
+        seqs[gang] = [(s.vector_clock, np.asarray(s.theta).tobytes())
+                      for s in reg.snapshots()]
+    assert len(seqs[True]) > 1
+    assert seqs[True] == seqs[False]
+
+
+def test_snapshot_clock_is_min_active_clock():
+    app, _, _ = build_app(serve_cfg(0))
+    reg = SnapshotRegistry(capacity=1024)
+    app.server.serving = reg
+    app.run_serial(max_server_iterations=24)
+    final = reg.latest
+    tracker = app.server.tracker
+    assert final.vector_clock == min(
+        tracker.tracker[w].vector_clock for w in tracker.active_workers)
+    assert final.theta is app.server.theta     # O(1) alias, not a copy
+
+
+# -- engine: batching, correctness, rejections -------------------------------
+
+
+def test_engine_batches_and_is_correct_under_threads():
+    app, x, _ = build_app(serve_cfg(0))
+    engine = app.enable_serving()
+    try:
+        app.run_serial(max_server_iterations=24)
+        theta = app.server.theta
+        expect = np.argmax(np.asarray(
+            app.server.task.predict_logits(theta, x[:32])), axis=1)
+
+        results = [None] * 32
+
+        def drive(t):
+            for j in range(t * 8, t * 8 + 8):
+                results[j] = engine.predict(x[j])
+
+        ths = [threading.Thread(target=drive, args=(t,)) for t in range(4)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        for j, pred in enumerate(results):
+            assert pred.label == int(expect[j]), (j, pred)
+            assert 0.0 < pred.confidence <= 1.0
+            assert pred.vector_clock == app.server.serving_clock()
+        s = engine.stats()
+        assert s["requests"] >= 32
+        assert s["batches"] < s["requests"], s   # concurrency batched
+        assert s["occupancy"] > 1.0, s
+    finally:
+        app.close_serving()
+
+
+def test_engine_staleness_rejection_paths():
+    app, x, _ = build_app(serve_cfg(0))
+    engine = app.enable_serving()
+    try:
+        # before any snapshot: empty registry rejects even unbounded
+        with pytest.raises(StalenessError):
+            engine.predict(x[0])
+        app.run_serial(max_server_iterations=12)
+        engine.predict(x[0])                     # now serveable
+        with pytest.raises(StalenessError):
+            engine.predict(x[0], min_clock=10**9)
+        with pytest.raises(StalenessError):
+            engine.predict(x[0], max_age_s=0.0)
+        assert engine.stats()["rejections"] >= 3
+    finally:
+        app.close_serving()
+
+
+def test_engine_rejects_after_close():
+    app, x, _ = build_app(serve_cfg(0))
+    engine = app.enable_serving()
+    app.run_serial(max_server_iterations=12)
+    app.close_serving()
+    with pytest.raises(RuntimeError):
+        engine.predict(x[0])
+
+
+# -- the invariant: serving never perturbs training --------------------------
+
+
+@pytest.mark.parametrize("consistency", [0, 3, EVENTUAL])
+def test_serving_does_not_perturb_training(consistency):
+    """With serving enabled and a live read load, the trainer's final
+    theta and metric rows are bitwise what they are without serving —
+    snapshots alias the immutable device theta; nothing feeds back."""
+    results = {}
+    for serve in (True, False):
+        logs = {"server": [], "worker": []}
+        app, x, _ = build_app(serve_cfg(consistency),
+                              server_log=logs["server"].append,
+                              worker_log=logs["worker"].append)
+        stop = threading.Event()
+        predictor = None
+        if serve:
+            engine = app.enable_serving()
+
+            def load():
+                while not stop.is_set():
+                    try:
+                        engine.predict(x[0], timeout=5.0)
+                    except StalenessError:
+                        pass             # pre-first-snapshot window
+
+            predictor = threading.Thread(target=load)
+            predictor.start()
+        try:
+            app.run_serial(max_server_iterations=40)
+        finally:
+            stop.set()
+            if predictor is not None:
+                predictor.join()
+                assert app.serving_engine.stats()["requests"] > 0
+            app.close_serving()
+        results[serve] = (np.asarray(app.server.theta), logs)
+    theta_on, logs_on = results[True]
+    theta_off, logs_off = results[False]
+    assert theta_on.tobytes() == theta_off.tobytes()
+    assert strip_ts(logs_on["worker"]) == strip_ts(logs_off["worker"])
+    assert strip_ts(logs_on["server"]) == strip_ts(logs_off["server"])
+
+
+def test_threaded_runtime_serves_while_training():
+    """Hot-swap smoke on the REAL concurrent runtime: a predictor
+    thread reads throughout a threaded training run; every answer is a
+    fully-formed snapshot and the clock never goes backwards."""
+    app, x, _ = build_app(serve_cfg(0))
+    engine = app.enable_serving()
+    stop = threading.Event()
+    seen = []
+    errors = []
+
+    def load():
+        last = -1
+        while not stop.is_set():
+            try:
+                p = engine.predict(x[0], timeout=5.0)
+            except StalenessError:
+                continue
+            if p.vector_clock < last:
+                errors.append(f"clock regressed {p.vector_clock} < {last}")
+                return
+            last = p.vector_clock
+            seen.append(p.vector_clock)
+
+    predictor = threading.Thread(target=load)
+    predictor.start()
+    try:
+        app.run_threaded(max_server_iterations=40)
+    finally:
+        stop.set()
+        predictor.join()
+        app.close_serving()
+    assert not errors, errors
+    assert seen and seen[-1] > 0
