@@ -104,6 +104,16 @@ def build_parser(include_server_flags: bool = True,
                         "worker iterations — logreg and mlp families "
                         "(ops/fused_update.py; auto-falls-back off-TPU "
                         "or past the VMEM budget)")
+    p.add_argument("--compress", default="none", metavar="CODEC",
+                   help="compressed delta transport "
+                        "(kafka_ps_tpu/compress/, docs/COMPRESSION.md): "
+                        "none | bf16 | int8 | topk:<ratio>.  Applied "
+                        "symmetrically — server->worker weights are "
+                        "quantize-dequantized, worker->server deltas go "
+                        "through per-worker error-feedback residuals.  "
+                        "In socket mode both processes must name the "
+                        "same codec (negotiated on HELLO; mismatches "
+                        "fall back to none).  Incompatible with --fused")
     p.add_argument("--no-gang", action="store_true", dest="no_gang",
                    help="disable gang-scheduled dispatch: process every "
                         "gate release as its own device step instead of "
@@ -201,6 +211,7 @@ def make_app_from_args(args, resuming: bool = False,
         use_pallas=args.pallas,
         eval_every=getattr(args, "eval_every", 1),
         use_gang=not getattr(args, "no_gang", False),
+        compress=getattr(args, "compress", "none") or "none",
         serving=ServingConfig(
             enabled=getattr(args, "serve", False),
             port=getattr(args, "serve_port", None),
@@ -276,6 +287,22 @@ def run_with_args(args) -> int:
     if getattr(args, "serve_port", None) is not None \
             and not getattr(args, "serve", False):
         raise SystemExit("--serve_port requires --serve")
+    compress = getattr(args, "compress", "none") or "none"
+    if compress != "none":
+        from kafka_ps_tpu.compress.wire import parse_codec
+        try:
+            parse_codec(compress)
+        except ValueError as e:
+            raise SystemExit(f"--compress: {e}") from None
+        if args.fused:
+            # the fused BSP step moves deltas through shard_map
+            # collectives that never cross a serde boundary — there is
+            # no wire to compress, and silently ignoring the flag would
+            # misreport what ran
+            raise SystemExit(
+                "--compress applies to the message transport (per-node "
+                "and socket modes); the --fused collectives never cross "
+                "a serde boundary — drop one of the two flags")
     distributed = False
     if args.remote:
         from kafka_ps_tpu.parallel import multihost
@@ -332,7 +359,8 @@ def run_with_args(args) -> int:
         # of remote workers' buffers would be empty lies — skip them
         ckpt_buffers = app.buffers if not distributed else None
         restored = ckpt.maybe_restore(args.checkpoint, app.server,
-                                      buffers=ckpt_buffers)
+                                      buffers=ckpt_buffers,
+                                      residuals=app.compressors or None)
         if restored and args.verbose:
             print(f"    restored checkpoint at iteration "
                   f"{app.server.iterations}")

@@ -60,7 +60,82 @@ def _make_cfg(args):
         # about remote queue contents anyway — split mode stays
         # per-message
         use_gang=False,
+        compress=getattr(args, "compress", "none") or "none",
     )
+
+
+def _codec_spec(args):
+    """Validate and parse --compress (host-side only, no jax import)."""
+    from kafka_ps_tpu.compress import wire as cwire
+    try:
+        return cwire.parse_codec(getattr(args, "compress", "none") or "none")
+    except ValueError as e:
+        raise SystemExit(f"--compress: {e}") from None
+
+
+class _BatchingSink:
+    """Producer sink that coalesces stream rows into T_DATA_BATCH frames.
+
+    Per-worker row buffers flush on size (one frame per `batch` rows) or
+    age (`flush_aged`, called from the server main loop's poll tick, so
+    a trickling stream never strands rows).  Delivery goes through
+    ServerBridge.send_data_batch — one frame, one syscall, one receiver
+    lock for the whole batch — and falls back to the per-row sink (which
+    owns the reroute/eviction policy) whenever the batch path can't
+    deliver.  Thread-safe: the producer thread adds while the main loop
+    flushes; a size-flush racing an age-flush can reorder rows between
+    frames, which the reroute path already permits (sliding-buffer
+    ingest is order-insensitive beyond insertion ids).
+    """
+
+    def __init__(self, bridge, fallback, deliverable,
+                 batch: int = 32, max_age: float = 0.05):
+        self._bridge = bridge
+        self._fallback = fallback      # per-row sink with reroute logic
+        self._deliverable = deliverable
+        self._batch = batch
+        self._max_age = max_age
+        self._rows: dict[int, list] = {}
+        self._oldest: dict[int, float] = {}   # worker -> first-row time
+        self._lock = threading.Lock()
+
+    def __call__(self, worker: int, features, label: int) -> None:
+        with self._lock:
+            rows = self._rows.setdefault(worker, [])
+            if not rows:
+                self._oldest[worker] = time.monotonic()
+            rows.append((features, label))
+            if len(rows) < self._batch:
+                return
+            del self._rows[worker]
+            self._oldest.pop(worker, None)
+        self._deliver(worker, rows)
+
+    def flush_aged(self) -> None:
+        """Flush every batch whose FIRST row has waited >= max_age."""
+        now = time.monotonic()
+        due = []
+        with self._lock:
+            for w, t0 in list(self._oldest.items()):
+                if now - t0 >= self._max_age:
+                    due.append((w, self._rows.pop(w)))
+                    del self._oldest[w]
+        for w, rows in due:
+            self._deliver(w, rows)
+
+    def flush_all(self) -> None:
+        with self._lock:
+            pending = [(w, self._rows.pop(w)) for w in list(self._rows)]
+            self._oldest.clear()
+        for w, rows in pending:
+            self._deliver(w, rows)
+
+    def _deliver(self, worker: int, rows) -> None:
+        if self._deliverable(worker) and self._bridge.send_data_batch(
+                worker, rows):
+            return
+        for features, label in rows:
+            self._fallback(worker, features, label)
 
 
 def run_server(args) -> int:
@@ -84,6 +159,7 @@ def run_server(args) -> int:
                                            NullLogSink, SERVER_HEADER)
 
     cfg = _make_cfg(args)
+    codec_spec = _codec_spec(args)
     failure_policy = getattr(args, "failure_policy", "halt")
     hb_timeout = getattr(args, "heartbeat_timeout", None)
     test_x, test_y = load_test_csv(args.test_data_file_path,
@@ -113,11 +189,22 @@ def run_server(args) -> int:
         port=args.listen,
         heartbeat_interval=min(1.0, hb_timeout / 3) if hb_timeout else 1.0,
         heartbeat_timeout=hb_timeout,
-        run_id=run_id)
+        run_id=run_id,
+        codec=codec_spec)
     print(f"listening on port {bridge.port}", file=sys.stderr, flush=True)
     from kafka_ps_tpu.utils.asynclog import DeferredSink
     fabric = bridge.wrap(fabric_mod.Fabric())
     server = ServerNode(cfg, fabric, test_x, test_y, DeferredSink(log))
+    if codec_spec.codec_id != net.CODEC_NONE:
+        # weights leave this process quantize-dequantized so both sides
+        # train against the SAME decoded theta; per-connection fallback
+        # (a peer that negotiated NONE gets plain frames) lives in
+        # ServerBridge._send
+        from kafka_ps_tpu import compress
+        codec = compress.get_codec(codec_spec, server.task.num_params)
+        server.compressor = compress.WeightsCompressor(codec)
+        print(f"compression: {codec_spec.name}", file=sys.stderr,
+              flush=True)
     server.run_id = run_id
     server.membership_log = events_log   # before restore: it logs "resume"
 
@@ -183,8 +270,12 @@ def run_server(args) -> int:
                 return
         reroute["dropped"] += 1
 
+    batch_sink = _BatchingSink(
+        bridge, sink,
+        deliverable=lambda w: (failure_policy == "rebalance"
+                               or server.tracker.tracker[w].active))
     producer = CsvStreamProducer(
-        args.training_data_file_path, cfg.num_workers, sink,
+        args.training_data_file_path, cfg.num_workers, batch_sink,
         time_per_event_ms=cfg.stream.time_per_event_ms,
         prefill_per_worker=cfg.stream.prefill_per_worker)
     producer.run_in_background()
@@ -254,6 +345,7 @@ def run_server(args) -> int:
     try:
         while server.iterations < max_iters:
             apply_events()
+            batch_sink.flush_aged()   # age-bound the batched ingest path
             g = fabric.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0,
                                      timeout=0.2)
             if g is not None:
@@ -267,6 +359,7 @@ def run_server(args) -> int:
         producer.stop()      # join the pump before teardown (SIGABRT
                              # discipline: no native-code daemon threads
                              # may outlive the main thread)
+        batch_sink.flush_all()   # after the pump join: no concurrent adds
         bridge.close()       # workers see EOF and shut down; joins
                              # accept/heartbeat/reader threads
         if engine is not None:
@@ -296,11 +389,25 @@ def run_worker(args) -> int:
                                    args.num_features)
 
     # connect FIRST: the handshake (net.T_CONFIG) carries the server's
-    # logical-run id, which decides whether local state is valid below
+    # logical-run id, which decides whether local state is valid below,
+    # and the NEGOTIATED codec — compression runs at what the server
+    # agreed to, not at what this process asked for (a mixed-version
+    # server replies NONE and both sides ship plain frames)
     bridge = net.WorkerBridge(
         host or "127.0.0.1", int(port), ids,
-        heartbeat_timeout=getattr(args, "heartbeat_timeout", None))
+        heartbeat_timeout=getattr(args, "heartbeat_timeout", None),
+        codec=_codec_spec(args))
     fabric = bridge.make_fabric()
+
+    compressors = None
+    if bridge.negotiated.codec_id != net.CODEC_NONE:
+        from kafka_ps_tpu import compress
+        from kafka_ps_tpu.models.task import get_task
+        codec = compress.get_codec(
+            bridge.negotiated, get_task(cfg.task, cfg.model).num_params)
+        compressors = {w: compress.ErrorFeedback(codec) for w in ids}
+        print(f"compression: {bridge.negotiated.name} (negotiated)",
+              file=sys.stderr, flush=True)
 
     # worker-local durable state (utils/checkpoint.py): the per-process
     # analogue of the reference's changelog-backed store restore
@@ -346,7 +453,8 @@ def run_worker(args) -> int:
     if restoring:
         from kafka_ps_tpu.utils import checkpoint as ckpt
         if ckpt.maybe_restore_worker(state_path, buffers,
-                                     run_id=bridge.server_run_id):
+                                     run_id=bridge.server_run_id,
+                                     residuals=compressors):
             print("restored worker buffers: " + ", ".join(
                 f"{w}:{buffers[w].count} rows (seen "
                 f"{buffers[w].num_tuples_seen})" for w in ids),
@@ -356,6 +464,9 @@ def run_worker(args) -> int:
     nodes = {w: WorkerNode(w, cfg, fabric, buffers[w], test_x, test_y,
                            worker_log)
              for w in ids}
+    if compressors is not None:
+        for w in ids:
+            nodes[w].compressor = compressors[w]
 
     if state_path is not None:
         from kafka_ps_tpu.utils import checkpoint as ckpt
@@ -369,14 +480,20 @@ def run_worker(args) -> int:
         def state_saver():
             # the changelog analogue: snapshot on a cadence (the
             # --state_every flag) so a SIGKILL'd process loses at most
-            # one interval of rows; skip idle intervals (no new
-            # insertions = same slab)
+            # one interval of rows; skip idle intervals.  The
+            # fingerprint covers insertions AND iteration counts: under
+            # compression the error-feedback residuals advance on every
+            # local iteration even when no new rows arrived, and a
+            # snapshot that missed them would replay a biased stream
+            # after a crash.
             last = None
             while not state_stop.wait(state_every):
-                fp = tuple(buffers[w].num_tuples_seen for w in ids)
+                fp = (tuple(buffers[w].num_tuples_seen for w in ids),
+                      tuple(nodes[w].iterations for w in ids))
                 if fp != last:
                     ckpt.save_worker(state_path, buffers,
-                                     run_id=bridge.server_run_id)
+                                     run_id=bridge.server_run_id,
+                                     residuals=compressors)
                     last = fp
 
         state_saver_thread = threading.Thread(
@@ -454,7 +571,8 @@ def run_worker(args) -> int:
             leftover.append(state_saver_thread.name)
         else:
             ckpt.save_worker(state_path, buffers,   # final snapshot
-                             run_id=bridge.server_run_id)
+                             run_id=bridge.server_run_id,
+                             residuals=compressors)
     worker_log.close()    # joins the drain thread, flushes, closes log
     bridge.close()
     reader_thread.join(timeout=10.0)  # EOF/closed socket ends it

@@ -1,0 +1,22 @@
+"""Compressed delta transport: device-side codecs (bf16 / int8 /
+topk:R), error-feedback residuals, and the host wire format.  See
+docs/COMPRESSION.md.
+
+``compress.wire`` is importable without jax (runtime/serde.py depends
+only on it); importing this package root pulls in the device codecs.
+"""
+
+from kafka_ps_tpu.compress.codecs import (Codec, WeightsCompressor,
+                                          decode_message_parts, get_codec,
+                                          make_compressor)
+from kafka_ps_tpu.compress.feedback import ErrorFeedback
+from kafka_ps_tpu.compress.wire import (CODEC_BF16, CODEC_INT8, CODEC_NONE,
+                                        CODEC_TOPK, INT8_CHUNK, NONE,
+                                        CodecSpec, parse_codec)
+
+__all__ = [
+    "Codec", "CodecSpec", "ErrorFeedback", "WeightsCompressor",
+    "CODEC_NONE", "CODEC_BF16", "CODEC_INT8", "CODEC_TOPK", "INT8_CHUNK",
+    "NONE", "decode_message_parts", "get_codec", "make_compressor",
+    "parse_codec",
+]

@@ -1,0 +1,182 @@
+"""Device-side codecs: jit'd encode/decode over the flat parameter
+vector (docs/COMPRESSION.md).
+
+Encoding runs ON DEVICE — the D2H fetch at the socket boundary
+(runtime/serde.py) then moves the small encoded parts (1-2 bytes per
+value, or 8 bytes per kept value for top-k) instead of 4n bytes of
+float32.  Decoding is also a device program: the receiver H2D-uploads
+the encoded parts and expands them with one dispatch, so the values a
+message carries stay jax arrays end to end (the per-node hot path's
+no-host-sync property, runtime/worker.py).
+
+Determinism contract: decode(unpack(pack(encode(v)))) on the receiver
+is bitwise-identical to decode(encode(v)) on the sender — pack/unpack
+are exact (compress/wire.py) and decode is one fixed program — which is
+what keeps error feedback (compress/feedback.py) and durable-log replay
+(log/durable_fabric.py) exact across process boundaries.
+
+All programs are cached per (spec, n): N logical workers pay one
+trace/compile, like runtime/worker._solver_fns.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from kafka_ps_tpu.compress import wire
+from kafka_ps_tpu.compress.wire import (CODEC_BF16, CODEC_INT8, CODEC_NONE,
+                                        CODEC_TOPK, INT8_CHUNK, CodecSpec)
+from kafka_ps_tpu.runtime.messages import EncodedValues
+
+
+def _build_fns(spec: CodecSpec, n: int):
+    """(encode, decode) as traceable functions over an n-vector."""
+    if spec.codec_id == CODEC_BF16:
+        def encode(v):
+            return (jax.lax.bitcast_convert_type(
+                v.astype(jnp.bfloat16), jnp.uint16),)
+
+        def decode(bits):
+            return jax.lax.bitcast_convert_type(
+                bits, jnp.bfloat16).astype(jnp.float32)
+        return encode, decode
+
+    if spec.codec_id == CODEC_INT8:
+        nchunks = wire.int8_chunks(n)
+        pad = nchunks * INT8_CHUNK - n
+
+        def encode(v):
+            r = jnp.pad(v, (0, pad)).reshape(nchunks, INT8_CHUNK)
+            scale = jnp.max(jnp.abs(r), axis=1) / 127.0
+            safe = jnp.where(scale > 0, scale, 1.0)
+            q = jnp.clip(jnp.round(r / safe[:, None]), -127, 127)
+            return q.astype(jnp.int8).reshape(-1), scale
+
+        def decode(q, scale):
+            r = (q.reshape(nchunks, INT8_CHUNK).astype(jnp.float32)
+                 * scale[:, None])
+            return r.reshape(-1)[:n]
+        return encode, decode
+
+    if spec.codec_id == CODEC_TOPK:
+        k = wire.topk_k(spec.param, n)
+
+        def encode(v):
+            # lax.top_k breaks ties toward the lower index — the
+            # selection (and therefore the wire bytes) is deterministic
+            _, idx = jax.lax.top_k(jnp.abs(v), k)
+            return idx.astype(jnp.int32), v[idx]
+
+        def decode(idx, vals):
+            return jnp.zeros((n,), jnp.float32).at[idx].set(
+                vals, unique_indices=True)
+        return encode, decode
+
+    raise ValueError(f"no device codec for {spec.spec_str()!r}")
+
+
+class Codec:
+    """Compiled encode/decode programs for one (spec, n)."""
+
+    def __init__(self, spec: CodecSpec, n: int):
+        self.spec = spec
+        self.n = n
+        encode, decode = _build_fns(spec, n)
+        self._encode = jax.jit(encode)
+        self._decode = jax.jit(decode)
+
+        # Every decoded value the SENDER keeps (message values, EF
+        # residual) must come from the SAME `_decode` program the
+        # receiver/replay path runs — fusing decode into a larger
+        # program lets XLA produce 1-ULP-different floats, which breaks
+        # the bitwise EF/replay contract.  So the sender-side steps are
+        # split: a fused front half up to the encoded parts, then the
+        # shared `_decode`, then the residual subtraction.
+        def ef_front(delta, residual):
+            c = delta + residual
+            return (c, *encode(c))
+        self._ef_front = jax.jit(ef_front)
+        self._sub = jax.jit(lambda c, d: c - d)
+
+    def encode(self, v):
+        """v (f32, length n) -> tuple of device-encoded parts."""
+        return tuple(self._encode(jnp.asarray(v, jnp.float32)))
+
+    def decode(self, *parts):
+        """Encoded parts (device or host arrays) -> f32 device array."""
+        return self._decode(*parts)
+
+    def roundtrip(self, v):
+        """(decoded, parts) — quantize-dequantize via the shared
+        decode program (the weights side, ServerNode._weights_message)."""
+        parts = self.encode(v)
+        return self._decode(*parts), parts
+
+    def ef_step(self, delta, residual):
+        """(decoded, new_residual, parts): compensate + encode fused,
+        then the shared decode, then the residual carry."""
+        out = self._ef_front(jnp.asarray(delta, jnp.float32), residual)
+        c, parts = out[0], tuple(out[1:])
+        d = self._decode(*parts)
+        return d, self._sub(c, d), parts
+
+    def encoded(self, parts) -> EncodedValues:
+        """Wrap device parts as the message-borne encoded payload
+        (runtime/messages.EncodedValues) serde serializes verbatim."""
+        return EncodedValues(codec_id=self.spec.codec_id,
+                             param=self.spec.param, parts=tuple(parts))
+
+
+@functools.lru_cache(maxsize=None)
+def get_codec(spec: CodecSpec, n: int) -> Codec:
+    return Codec(spec, n)
+
+
+class WeightsCompressor:
+    """Server->worker weights compression: plain quantize-dequantize,
+    NO error feedback — weights are state, not an accumulated signal,
+    so carrying a residual would smear old quantization error into
+    unrelated rounds.  The master theta stays full-precision on the
+    server; every worker (in-process or across the socket) trains on
+    the identical decoded copy.
+
+    A one-entry identity cache covers the dominant pattern: the
+    consistency gate releases the SAME theta object to many workers at
+    one moment (theta is updated by replacement, runtime/server.py), so
+    a multi-worker release encodes once."""
+
+    def __init__(self, codec: Codec):
+        self.codec = codec
+        self._cache = None          # (theta_ref, decoded, EncodedValues)
+
+    def encode(self, theta):
+        c = self._cache
+        if c is not None and c[0] is theta:
+            return c[1], c[2]
+        decoded, parts = self.codec.roundtrip(theta)
+        enc = self.codec.encoded(parts)
+        self._cache = (theta, decoded, enc)
+        return decoded, enc
+
+
+def make_compressor(compress: str | CodecSpec, n: int):
+    """`--compress` value -> WeightsCompressor, or None for "none"."""
+    spec = (compress if isinstance(compress, CodecSpec)
+            else wire.parse_codec(compress))
+    if spec.codec_id == CODEC_NONE:
+        return None
+    return WeightsCompressor(get_codec(spec, n))
+
+
+def decode_message_parts(codec_id: int, param: float, parts, n: int):
+    """Receiver-side decode used by serde.from_bytes: H2D the unpacked
+    parts and expand on device.  Returns (values, EncodedValues) so a
+    decoded message re-serializes byte-identically (durable-log
+    append of a replayed frame)."""
+    codec = get_codec(CodecSpec(codec_id, param), n)
+    parts = tuple(parts)
+    return codec.decode(*parts), EncodedValues(
+        codec_id=codec_id, param=codec.spec.param, parts=parts)

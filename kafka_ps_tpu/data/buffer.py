@@ -91,6 +91,16 @@ class SlidingBuffer:
         with self._lock:
             self._add_locked(features, label)
 
+    def add_many(self, rows) -> None:
+        """Insert N (features, label) samples under ONE lock acquisition
+        — the bulk half of the batched ingest path (net.T_DATA_BATCH,
+        ServerBridge.send_data_batch).  Policy-identical to N add()
+        calls: arrival recording and the dynamic-target eviction run
+        per row, only the lock round-trips are amortized."""
+        with self._lock:
+            for features, label in rows:
+                self._add_locked(features, label)
+
     def _add_locked(self, features, label: int) -> None:
         self._record_arrival()
         target = self.target_size()

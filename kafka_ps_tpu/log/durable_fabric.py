@@ -201,6 +201,14 @@ class DurableFabric(Fabric):
                                "drive loop")
         self._recovered = True
         counts = {WEIGHTS_TOPIC: 0, GRADIENTS_TOPIC: 0}
+        # A live gate release aliases ONE message object into every
+        # worker's partition; the gang dispatcher keys its broadcast-vs-
+        # stacked program choice on that identity (runtime/gang.py).
+        # Deserializing each partition's copy separately would replay
+        # the same release through a DIFFERENT XLA program (1-ULP delta
+        # drift, poisonous under error-feedback compression) — so byte-
+        # identical weights payloads re-share one deserialized object.
+        weights_cache: dict[bytes, object] = {}
         with self._cond:
             for topic, key in self.manager.partitions():
                 start = self.start_offset(topic, key, checkpoint_offsets)
@@ -210,7 +218,15 @@ class DurableFabric(Fabric):
                 q = self._q(topic, key)
                 for offset, payload in \
                         self.manager.get(topic, key).read_from(start):
-                    q.append((offset, serde.from_bytes(payload)))
+                    if topic == WEIGHTS_TOPIC:
+                        blob = bytes(payload)
+                        msg = weights_cache.get(blob)
+                        if msg is None:
+                            msg = serde.from_bytes(payload)
+                            weights_cache[blob] = msg
+                    else:
+                        msg = serde.from_bytes(payload)
+                    q.append((offset, msg))
                     counts[topic] = counts.get(topic, 0) + 1
                     self._tracer.count(f"log.replays.{topic}")
             self._cond.notify_all()

@@ -68,6 +68,22 @@ class StreamingPSApp:
             WorkerNode(w, cfg, self.fabric, self.buffers[w], test_x, test_y,
                        worker_log, tracer=self.tracer)
             for w in range(cfg.num_workers)]
+        # compressed delta transport (kafka_ps_tpu/compress/): one shared
+        # weights compressor on the server, one error-feedback residual
+        # per worker.  {} when --compress none — everything above runs
+        # untouched (messages carry no encoded payloads).
+        self.compressors: dict[int, object] = {}
+        if cfg.compress and cfg.compress != "none":
+            from kafka_ps_tpu import compress
+            codec = compress.get_codec(compress.parse_codec(cfg.compress),
+                                       self.server.task.num_params)
+            self.server.compressor = compress.WeightsCompressor(codec)
+            for w in self.workers:
+                w.compressor = compress.ErrorFeedback(codec)
+                self.compressors[w.worker_id] = w.compressor
+            # residuals are worker state: in-process runs fold them into
+            # the server-side checkpoint next to the buffers
+            self.server.checkpoint_residuals = self.compressors
         self._stop = threading.Event()
         # fused-program cache: re-entering run_fused_bsp (resume, bench
         # trials, alternating with other drive modes) must reuse the

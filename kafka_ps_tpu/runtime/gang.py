@@ -197,6 +197,15 @@ class GangDispatcher:
         self._offer_lock = threading.Lock()
         # (worker_id, clock) -> the full member tuple of its notice
         self._notices: dict[tuple[int, int], tuple] = {}
+        # error-feedback compression needs crash-recovery replay to
+        # re-run the EXACT device programs the live run dispatched; a
+        # recovery claim can merge releases the live run dispatched
+        # separately (the restarted gate re-fires them inside one
+        # batched apply), so compressed runs group members by clock —
+        # one dispatch per release set — instead of letting a single
+        # stacked program span clocks
+        self._per_clock = bool(getattr(cfg, "compress", "none")
+                               not in (None, "", "none"))
         # grid pallas batching fell over at runtime -> vmap-of-kernel
         self._grid = True
 
@@ -216,8 +225,11 @@ class GangDispatcher:
                 if not _gangable(self.workers[w]):
                     continue    # left queued for the per-message loop
                 msg = self.fabric.poll(fabric_mod.WEIGHTS_TOPIC, w)
-                if msg is not None:
-                    members.append((self.workers[w], msg))
+                if msg is None:
+                    continue
+                if self.workers[w]._redelivered_weights(msg):
+                    continue    # recovery duplicate: cached resend only
+                members.append((self.workers[w], msg))
             if not members:
                 continue            # set already consumed elsewhere
             if len(members) == 1:
@@ -237,6 +249,8 @@ class GangDispatcher:
         if not _gangable(worker):
             worker.on_weights(msg)
             return
+        if worker._redelivered_weights(msg):
+            return              # recovery duplicate: cached resend only
         with self._offer_lock:
             self._refresh_notices()
             # entries superseded by this worker's own progress can never
@@ -256,8 +270,11 @@ class GangDispatcher:
                     if not _gangable(self.workers[w]):
                         continue    # its own thread delivers per-message
                     sib = self.fabric.poll(fabric_mod.WEIGHTS_TOPIC, w)
-                    if sib is not None:
-                        members.append((self.workers[w], sib))
+                    if sib is None:
+                        continue
+                    if self.workers[w]._redelivered_weights(sib):
+                        continue    # recovery duplicate: cached resend
+                    members.append((self.workers[w], sib))
                 for w, c in spec:   # claimed: latecomers run solo
                     self._notices.pop((w, c), None)
         if members is None or len(members) == 1:
@@ -301,16 +318,25 @@ class GangDispatcher:
             except BaseException as e:   # the healthy members still run
                 failures.append(GangMemberError(w.worker_id, e))
         results: dict[int, tuple] = {}
-        eval_grp = [p for p in prepared if p[7]]
-        noeval_grp = [p for p in prepared if not p[7]]
-        for grp, with_eval in ((eval_grp, True), (noeval_grp, False)):
-            if grp:
+        if self._per_clock:
+            grouped: dict[tuple, list] = {}
+            for p in prepared:
+                grouped.setdefault((p[7], p[1].vector_clock),
+                                   []).append(p)
+            for (with_eval, _), grp in grouped.items():
                 self._dispatch_group(grp, with_eval, results)
+        else:
+            eval_grp = [p for p in prepared if p[7]]
+            noeval_grp = [p for p in prepared if not p[7]]
+            for grp, with_eval in ((eval_grp, True), (noeval_grp, False)):
+                if grp:
+                    self._dispatch_group(grp, with_eval, results)
         # _finish in member order: CSV rows and GradientMessages hit
         # their queues in exactly the per-message order
         for p in prepared:
             w, msg, _, _, _, _, seen, _ = p
-            w._finish(msg, seen, *results[w.worker_id])
+            w._finish(msg, seen,
+                      *results[(w.worker_id, msg.vector_clock)])
         if failures:
             raise failures[0]
 
@@ -330,7 +356,8 @@ class GangDispatcher:
                     delta, loss = update_fn(theta, x, y, mask)
                     f1 = acc = -1.0
             self.tracer.count("dispatch.device")
-            results[w.worker_id] = (delta, loss, f1, acc)
+            results[(w.worker_id, msg.vector_clock)] = (delta, loss,
+                                                        f1, acc)
             return
 
         thetas = [p[2] for p in grp]
@@ -379,5 +406,8 @@ class GangDispatcher:
         else:
             deltas, losses = out
             f1s = accs = (-1.0,) * k
+        # keyed by (worker, clock): a recovery claim can hold TWO
+        # messages for one worker (a merged notice spanning releases),
+        # and each one's result must reach its own _finish
         for p, d, l, f1, a in zip(grp, deltas, losses, f1s, accs):
-            results[p[0].worker_id] = (d, l, f1, a)
+            results[(p[0].worker_id, p[1].vector_clock)] = (d, l, f1, a)

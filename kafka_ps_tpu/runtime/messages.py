@@ -42,12 +42,32 @@ class KeyRange:
 
 
 @dataclasses.dataclass(frozen=True)
+class EncodedValues:
+    """Lossy-codec encoding of a message's values (compress/codecs.py):
+    codec id + parameter and the device-encoded parts exactly as the
+    sender produced them.  Serde serializes these parts verbatim rather
+    than re-encoding `values` — int8 quantization is not idempotent over
+    its own decoded output, and re-encoding would desync the sender's
+    error-feedback residual from what actually crossed the wire."""
+
+    codec_id: int
+    param: float
+    parts: tuple
+
+
+@dataclasses.dataclass(frozen=True)
 class BaseMessage:
-    """vector clock + key range + dense values (BaseMessage.java:17-32)."""
+    """vector clock + key range + dense values (BaseMessage.java:17-32).
+
+    `values` is ALWAYS the full-precision view every consumer computes
+    with (for a compressed message: the decoded floats, identical on
+    both sides of the socket).  `encoded` is transport metadata only —
+    present when a codec produced this message, None otherwise."""
 
     vector_clock: int
     key_range: KeyRange
     values: np.ndarray
+    encoded: EncodedValues | None = None
 
     def __post_init__(self):
         if len(self.values) != len(self.key_range):

@@ -16,14 +16,27 @@ Wire format, little-endian:
     frame  := <u32 length> <u8 topic> <i64 key> <payload>
     topic  := 1 WEIGHTS | 2 GRADIENTS | 3 INPUT_DATA | 4 HELLO | 5 READY
               | 6 PING | 7 PONG | 8 CONFIG | 9 PREDICT | 10 PREDICTION
-    payload:= serde.to_bytes(message)   (HELLO: <i64 n> <i64 ids[n]>;
+              | 11 DATA_BATCH
+    payload:= serde.to_bytes(message)   (HELLO: <i64 n> <i64 ids[n]>
+                                                [<u8 codec_id> <f32 param>];
                                          READY/PING/PONG: empty;
                                          CONFIG: <f64 ping_interval_s>
-                                                 <i64 run_id>;
+                                                 <i64 run_id>
+                                                 [<u8 codec_id> <f32 param>];
+                                         DATA_BATCH: <i64 nrows> then per
+                                         row <i32 len><serde bytes>;
                                          PREDICT / PREDICTION: see the
                                          encode_/decode_ helpers below)
 `key` is the logical worker id (the Kafka record key, CsvProducer.java:61);
 for PREDICT/PREDICTION it is the client's request id (echoed back).
+
+Codec negotiation (docs/COMPRESSION.md): HELLO optionally carries the
+worker's `--compress` codec; the server's CONFIG reply echoes the codec
+the pair will actually use — the server's own codec when both sides
+named the SAME one, `none` otherwise.  Both trailers are read with
+unpack_from, so an old peer simply never sees them and the pair falls
+back to uncompressed f32 frames — a `--compress none` fleet is
+byte-identical to before this field existed.
 
 Delivery properties preserved from the reference fabric: addressed
 per-worker delivery, per-connection FIFO (TCP), asynchronous buffering
@@ -34,18 +47,22 @@ ServerProcessor.java:172-182 (weights send), WorkerTrainingProcessor
 
 from __future__ import annotations
 
+import dataclasses
 import socket
 import struct
 import sys
 import threading
 import time
 
+from kafka_ps_tpu.compress.wire import NONE as CODEC_SPEC_NONE
+from kafka_ps_tpu.compress.wire import CODEC_NONE, CodecSpec
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime import serde
 
 _FRAME = struct.Struct("<IBq")          # length, topic, key
 (T_WEIGHTS, T_GRADIENTS, T_DATA, T_HELLO, T_READY,
- T_PING, T_PONG, T_CONFIG, T_PREDICT, T_PREDICTION) = range(1, 11)
+ T_PING, T_PONG, T_CONFIG, T_PREDICT, T_PREDICTION,
+ T_DATA_BATCH) = range(1, 12)
 # the full frame-topic table: data topics map to their fabric names,
 # control/serving topics to wire-only names (test_net_framing.py keeps
 # this exhaustive against the T_* constants)
@@ -54,7 +71,11 @@ TOPIC_NAMES = {T_WEIGHTS: fabric_mod.WEIGHTS_TOPIC,
                T_DATA: fabric_mod.INPUT_DATA_TOPIC,
                T_HELLO: "hello", T_READY: "ready",
                T_PING: "ping", T_PONG: "pong", T_CONFIG: "config",
-               T_PREDICT: "predict", T_PREDICTION: "prediction"}
+               T_PREDICT: "predict", T_PREDICTION: "prediction",
+               T_DATA_BATCH: "input-data-batch"}
+
+# the optional codec trailer on HELLO and CONFIG (negotiation above)
+_CODEC_TRAILER = struct.Struct("<Bf")
 
 # -- serving-plane payloads (kafka_ps_tpu/serving/, docs/SERVING.md) -------
 # PREDICT: the feature row plus the request's staleness bound; sentinel
@@ -102,8 +123,12 @@ def send_frame(sock: socket.socket, topic: int, key: int,
     sock.sendall(header + payload)
 
 
-def recv_frame(sock: socket.socket) -> tuple[int, int, bytes] | None:
-    """(topic, key, payload) or None on a clean EOF."""
+def recv_frame(sock: socket.socket) -> tuple[int, int, memoryview] | None:
+    """(topic, key, payload) or None on a clean EOF.  The payload is a
+    zero-copy memoryview into the received frame body — every decode
+    site (np.frombuffer, struct.unpack_from, zlib, serde) reads
+    bytes-likes, so slicing the 9-byte topic/key prefix no longer
+    copies the multi-KB message payload."""
     head = _recv_exact(sock, 4)
     if head is None:
         return None
@@ -112,7 +137,20 @@ def recv_frame(sock: socket.socket) -> tuple[int, int, bytes] | None:
     if body is None:
         raise ConnectionError("mid-frame EOF")
     topic, key = struct.unpack_from("<Bq", body, 0)
-    return topic, key, body[9:]
+    return topic, key, memoryview(body)[9:]
+
+
+def _read_codec_trailer(payload, offset: int) -> CodecSpec:
+    """The optional <u8 codec_id> <f32 param> trailer of a HELLO or
+    CONFIG payload; NONE when absent (old peer) or unintelligible
+    (newer peer with codec ids we don't know)."""
+    if len(payload) < offset + _CODEC_TRAILER.size:
+        return CODEC_SPEC_NONE
+    cid, param = _CODEC_TRAILER.unpack_from(payload, offset)
+    try:
+        return CodecSpec(cid, param)
+    except ValueError:
+        return CODEC_SPEC_NONE
 
 
 def force_close(sock: socket.socket) -> None:
@@ -171,13 +209,22 @@ class ServerBridge:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  heartbeat_interval: float | None = None,
                  heartbeat_timeout: float | None = None,
-                 run_id: int = 0):
+                 run_id: int = 0, codec: CodecSpec | None = None):
         # `run_id` identifies the logical RUN (fresh server start, or
         # the run a checkpoint resume continues — utils/checkpoint.py
         # persists it).  Advertised in T_CONFIG so worker processes can
         # tell whether their local state file belongs to THIS run or is
         # a stale leftover from an earlier one (cli/socket_mode.py).
         self.run_id = run_id
+        # `codec`: this server's `--compress` choice; per-connection
+        # negotiation (docstring above) lands in `_codec_of`, and sends
+        # to a none-negotiated peer strip the encoded payload in _send
+        self.codec = codec if codec is not None else CODEC_SPEC_NONE
+        self._codec_of: dict[socket.socket, CodecSpec] = {}
+        # bytes on the wire per frame topic, both directions, including
+        # the 13-byte frame header (the compression_ab bench reads this)
+        self.wire_bytes: dict[int, int] = {}
+        self._wire_lock = threading.Lock()
         self._listener = socket.create_server((host, port))
         self.port = self._listener.getsockname()[1]
         self._conn_of: dict[int, socket.socket] = {}   # worker -> conn
@@ -248,6 +295,24 @@ class ServerBridge:
             return False
         return self._send(conn, T_DATA, worker, LabeledData(features, label))
 
+    def send_data_batch(self, worker: int, rows) -> bool:
+        """Forward N stream rows to the process hosting `worker` in ONE
+        frame: <i64 nrows> then per row <i32 len><serde bytes>.  The
+        receiver inserts them under a single buffer lock (SlidingBuffer
+        .add_many) — amortizes the per-row frame + syscall + lock cost
+        on the ingest path.  `rows` is a sequence of (features, label);
+        False exactly like send_data (the caller reroutes the rows)."""
+        from kafka_ps_tpu.runtime.messages import LabeledData
+        conn = self._conn_of.get(worker)
+        if conn is None:
+            return False
+        chunks = [struct.pack("<q", len(rows))]
+        for features, label in rows:
+            blob = serde.to_bytes(LabeledData(features, label))
+            chunks.append(struct.pack("<i", len(blob)))
+            chunks.append(blob)
+        return self._send_raw(conn, T_DATA_BATCH, worker, b"".join(chunks))
+
     def wait_for_connected(self, workers, timeout: float = 60.0) -> None:
         """Block until every worker id has a connection (HELLO seen) —
         before this the producer has nowhere to send their rows."""
@@ -301,6 +366,16 @@ class ServerBridge:
         is dropped, like a Kafka send to a dead consumer — the reader's
         disconnect cleanup drives the actual eviction, so a send from
         inside the consistency gate can't crash the server."""
+        if (message is not None
+                and getattr(message, "encoded", None) is not None
+                and self._codec_of.get(conn,
+                                       CODEC_SPEC_NONE).codec_id
+                == CODEC_NONE):
+            # this peer negotiated no compression (old version, or
+            # `--compress none`): ship the decoded values as a plain f32
+            # frame — they ARE the values every compressed peer decodes
+            # to, so a mixed fleet stays consistent
+            message = dataclasses.replace(message, encoded=None)
         payload = serde.to_bytes(message) if message is not None else b""
         return self._send_raw(conn, topic, key, payload)
 
@@ -316,6 +391,9 @@ class ServerBridge:
         try:
             with lock:
                 send_frame(conn, topic, key, payload)
+            with self._wire_lock:
+                self.wire_bytes[topic] = (self.wire_bytes.get(topic, 0)
+                                          + _FRAME.size + len(payload))
             return True
         except (ConnectionError, OSError):
             self.dropped_sends += count
@@ -365,20 +443,34 @@ class ServerBridge:
                     break
                 self._last_recv[conn] = time.monotonic()
                 topic, key, payload = frame
+                with self._wire_lock:
+                    self.wire_bytes[topic] = (
+                        self.wire_bytes.get(topic, 0)
+                        + _FRAME.size + len(payload))
                 if topic == T_HELLO:
                     (n,) = struct.unpack_from("<q", payload, 0)
                     ids = struct.unpack_from(f"<{n}q", payload, 8)
+                    # negotiation: use our codec iff the peer asked for
+                    # the SAME one (old peers send no trailer -> NONE)
+                    peer = _read_codec_trailer(payload, 8 + 8 * n)
+                    negotiated = (self.codec if peer == self.codec
+                                  else CODEC_SPEC_NONE)
+                    self._codec_of[conn] = negotiated
                     # T_CONFIG goes out BEFORE the ids are registered:
                     # once registered, the producer thread may race data
                     # rows onto this connection, and the worker-side
                     # handshake relies on T_CONFIG being the first
                     # non-PING frame (per-connection FIFO).  Payload:
                     # PING cadence (0.0 = no heartbeats; the worker must
-                    # not time out at all) + the run id.
+                    # not time out at all) + the run id + the negotiated
+                    # codec (old workers unpack_from past the trailer).
                     self._send_raw(conn, T_CONFIG, 0,
                                    struct.pack("<dq",
                                                self._hb_interval or 0.0,
-                                               self.run_id))
+                                               self.run_id)
+                                   + _CODEC_TRAILER.pack(
+                                       negotiated.codec_id,
+                                       negotiated.param))
                     with self._cv:
                         for w in ids:
                             self._conn_of[w] = conn
@@ -453,6 +545,7 @@ class ServerBridge:
                 self._ready.discard(w)
             self._send_lock.pop(conn, None)
             self._last_recv.pop(conn, None)
+            self._codec_of.pop(conn, None)
             self._cv.notify_all()
         if ids and not self._stop.is_set() and self.on_disconnect is not None:
             self.on_disconnect(ids)
@@ -466,13 +559,22 @@ class WorkerBridge:
 
     def __init__(self, host: str, port: int, worker_ids: list[int],
                  connect_timeout: float = 30.0,
-                 heartbeat_timeout: float | None = None):
+                 heartbeat_timeout: float | None = None,
+                 codec: CodecSpec | None = None):
         """`heartbeat_timeout`: seconds of total server silence before
         the connection is declared dead (only sensible when the server
         PINGs, i.e. it was built with a heartbeat_interval — otherwise a
-        quiet-but-alive server would be misread as gone)."""
+        quiet-but-alive server would be misread as gone).
+        `codec`: this worker process's `--compress` choice, offered on
+        HELLO; `self.negotiated` holds what the server agreed to (NONE
+        against an old or differently-configured server) — the caller
+        builds its gradient compressors from THAT, not the flag."""
         self.worker_ids = list(worker_ids)
         self._heartbeat_timeout = heartbeat_timeout
+        self.codec = codec if codec is not None else CODEC_SPEC_NONE
+        self.negotiated = CODEC_SPEC_NONE
+        self.wire_bytes: dict[int, int] = {}
+        self._wire_lock = threading.Lock()
         # retry: the server process may still be importing/binding when
         # this process is already up (both launched together, run.sh-style)
         deadline = time.monotonic() + connect_timeout
@@ -490,8 +592,10 @@ class WorkerBridge:
         self._stop = threading.Event()
         self.disconnected = threading.Event()
         self.server_run_id: int | None = None
-        payload = struct.pack(f"<q{len(self.worker_ids)}q",
-                              len(self.worker_ids), *self.worker_ids)
+        payload = (struct.pack(f"<q{len(self.worker_ids)}q",
+                               len(self.worker_ids), *self.worker_ids)
+                   + _CODEC_TRAILER.pack(self.codec.codec_id,
+                                         self.codec.param))
         with self._send_lock:
             send_frame(self._sock, T_HELLO, 0, payload)
         # synchronous handshake: the server replies T_CONFIG before it
@@ -513,6 +617,9 @@ class WorkerBridge:
                 if topic == T_CONFIG:
                     interval, run_id = struct.unpack_from("<dq", pl, 0)
                     self.server_run_id = int(run_id)
+                    # a 16-byte CONFIG is an old server: no negotiation,
+                    # stay uncompressed (the server can't decode tid 4/5)
+                    self.negotiated = _read_codec_trailer(pl, 16)
                     break
                 raise ConnectionError(
                     f"expected T_CONFIG during handshake, got topic {topic}")
@@ -534,9 +641,13 @@ class WorkerBridge:
         class BridgedFabric(fabric_mod.Fabric):
             def send(self, topic, key, message):
                 if topic == fabric_mod.GRADIENTS_TOPIC:
+                    payload = serde.to_bytes(message)
                     with bridge._send_lock:
-                        send_frame(bridge._sock, T_GRADIENTS, key,
-                                   serde.to_bytes(message))
+                        send_frame(bridge._sock, T_GRADIENTS, key, payload)
+                    with bridge._wire_lock:
+                        bridge.wire_bytes[T_GRADIENTS] = (
+                            bridge.wire_bytes.get(T_GRADIENTS, 0)
+                            + _FRAME.size + len(payload))
                 else:
                     super().send(topic, key, message)
 
@@ -575,14 +686,19 @@ class WorkerBridge:
 
     def run_reader(self, buffers: dict[int, object]) -> None:
         """Blocking read loop (call on a dedicated thread or the main
-        thread): dispatches INPUT_DATA to `buffers[worker].add` and
-        WEIGHTS into the local fabric.  Returns on EOF (server done)."""
+        thread): dispatches INPUT_DATA to `buffers[worker].add` (batched
+        frames to `.add_many`) and WEIGHTS into the local fabric.
+        Returns on EOF (server done)."""
         try:
             while not self._stop.is_set():
                 frame = recv_frame(self._sock)
                 if frame is None:
                     break
                 topic, key, payload = frame
+                with self._wire_lock:
+                    self.wire_bytes[topic] = (
+                        self.wire_bytes.get(topic, 0)
+                        + _FRAME.size + len(payload))
                 if topic == T_PING:
                     with self._send_lock:
                         send_frame(self._sock, T_PONG, 0)
@@ -593,6 +709,18 @@ class WorkerBridge:
                     # decode — run id changes are not acted on)
                     (interval, _rid) = struct.unpack_from("<dq", payload, 0)
                     self._apply_server_ping_interval(interval)
+                    continue
+                if topic == T_DATA_BATCH:
+                    (nrows,) = struct.unpack_from("<q", payload, 0)
+                    off = 8
+                    rows = []
+                    for _ in range(nrows):
+                        (blen,) = struct.unpack_from("<i", payload, off)
+                        off += 4
+                        row = serde.from_bytes(payload[off:off + blen])
+                        off += blen
+                        rows.append((row.features, row.label))
+                    buffers[key].add_many(rows)
                     continue
                 msg = serde.from_bytes(payload)
                 if topic == T_DATA:

@@ -99,6 +99,15 @@ class ServerNode:
         # (durable training window); split mode leaves this None — each
         # worker process persists its own state file instead
         self.checkpoint_buffers = None
+        # weights-side compression (compress.WeightsCompressor, set by
+        # app/CLI wiring when --compress != none): every outgoing
+        # WeightsMessage carries quantize-dequantized values + the
+        # encoded parts; the master theta here stays full precision
+        self.compressor = None
+        # {worker: ErrorFeedback} for in-process runs — the residuals
+        # ride the checkpoint next to the buffers (split mode persists
+        # them in each worker process's state file instead)
+        self.checkpoint_residuals = None
         # durable-log recovery (log/durable_fabric.py): the committed
         # offsets the restored checkpoint covers — replay starts there
         self.restored_log_offsets: dict[str, int] | None = None
@@ -182,10 +191,16 @@ class ServerNode:
         # later in-place edit can't race an in-flight message
         values = (np.array(self.theta)
                   if isinstance(self.theta, np.ndarray) else self.theta)
+        encoded = None
+        if self.compressor is not None:
+            # every worker trains on the decoded (quantize-dequantized)
+            # copy — in-process consumers get it by reference, socket
+            # peers decode the SAME parts to the same floats
+            values, encoded = self.compressor.encode(values)
         return WeightsMessage(
             vector_clock=vector_clock,
             key_range=KeyRange(0, self.task.num_params),
-            values=values)
+            values=values, encoded=encoded)
 
     def send_weights(self, worker: int, clock: int) -> None:
         """The single weights-send site: dispatch + tracker bookkeeping +
@@ -549,11 +564,17 @@ class ServerNode:
         (process_batch records tracker.sent_message at gate-decision
         time; the send waits for the batched apply to yield the prefix
         theta this release observes)."""
+        encoded = None
+        if self.compressor is not None:
+            # prefix thetas of one batch are distinct arrays, but a
+            # multi-member release at the SAME position reuses the
+            # compressor's identity cache
+            theta, encoded = self.compressor.encode(theta)
         self.fabric.send(
             fabric_mod.WEIGHTS_TOPIC, worker,
             WeightsMessage(vector_clock=clock,
                            key_range=KeyRange(0, self.task.num_params),
-                           values=theta))
+                           values=theta, encoded=encoded))
         self.weights_sent_at[worker] = time.monotonic()
 
     def maybe_checkpoint(self) -> None:
@@ -581,7 +602,8 @@ class ServerNode:
         offsets = (self.fabric.snapshot_offsets()
                    if getattr(self.fabric, "durable", False) else None)
         ckpt.save(self.checkpoint_path, self,
-                  buffers=self.checkpoint_buffers, log_offsets=offsets)
+                  buffers=self.checkpoint_buffers, log_offsets=offsets,
+                  residuals=self.checkpoint_residuals)
         if offsets is not None:
             self.fabric.commit(offsets)
         self._last_checkpoint_iteration = self.iterations

@@ -112,6 +112,19 @@ class WorkerNode:
         # failure-detection heartbeat (read by the supervisor in
         # runtime/app.py): wall-clock of the last completed iteration
         self.last_progress = time.monotonic()
+        # gradient-side compression (compress.ErrorFeedback, set by the
+        # app/CLI wiring when --compress != none): each outgoing delta
+        # is error-compensated, encoded and decoded on device; the
+        # residual is part of this worker's checkpointable state
+        self.compressor = None
+        # (clock, GradientMessage) of the newest compressed send: crash
+        # recovery redelivers weights clocks the worker already trained
+        # on (the recovering gate re-releases what the replay also
+        # re-enqueues).  Stateless workers just recompute and let the
+        # server's clock filter drop the duplicate, but an EF residual
+        # must advance exactly once per clock — duplicates resend this
+        # cached message instead (_redelivered_weights)
+        self._last_sent = None
 
     def _prepare(self, msg: WeightsMessage):
         """Pre-dispatch half of an iteration, shared by the single-
@@ -164,16 +177,45 @@ class WorkerNode:
             loss, f1, acc)
         self.iterations += 1
 
-        self.fabric.send(
-            fabric_mod.GRADIENTS_TOPIC, 0,
-            GradientMessage(
-                vector_clock=msg.vector_clock,
-                key_range=KeyRange(0, self.task.num_params),
-                values=delta,
-                worker_id=self.worker_id))
+        encoded = None
+        if self.compressor is not None:
+            # what the server applies is the DECODED delta (identical on
+            # both sides of a socket); the quantization error stays here
+            # as the residual folded into the next iteration's delta
+            delta, encoded = self.compressor.step(delta)
+        out = GradientMessage(
+            vector_clock=msg.vector_clock,
+            key_range=KeyRange(0, self.task.num_params),
+            values=delta,
+            encoded=encoded,
+            worker_id=self.worker_id)
+        self.fabric.send(fabric_mod.GRADIENTS_TOPIC, 0, out)
+        if self.compressor is not None:
+            self._last_sent = (msg.vector_clock, out)
         self.last_progress = time.monotonic()
 
+    def _redelivered_weights(self, msg: WeightsMessage) -> bool:
+        """True when `msg` is a weights clock this worker already
+        trained on and the step must NOT run again.  Only compressed
+        workers dedup: re-running a step would advance the
+        error-feedback residual a second time for the same clock,
+        which is exactly the bitwise-replay corruption crash recovery
+        must avoid (tests/test_log_recovery.py).  The newest clock's
+        cached gradient is resent so a gate waiting on this worker
+        still completes (the server's clock filter drops it if the
+        original got through); older clocks are stale and dropped."""
+        if self.compressor is None:
+            return False
+        last = self._last_sent
+        if last is None or msg.vector_clock > last[0]:
+            return False
+        if msg.vector_clock == last[0]:
+            self.fabric.send(fabric_mod.GRADIENTS_TOPIC, 0, last[1])
+        return True
+
     def on_weights(self, msg: WeightsMessage) -> None:
+        if self._redelivered_weights(msg):
+            return
         theta, x, y, mask, seen, want_eval = self._prepare(msg)
 
         # Post-fit test metrics, like the reference's per-iteration eval

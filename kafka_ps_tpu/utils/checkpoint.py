@@ -57,13 +57,36 @@ def _unpack_buffers(z, buffers) -> bool:
     return found
 
 
+def _residual_items(residuals):
+    """Accept dict {worker: ErrorFeedback-like} (app.compressors /
+    socket_mode's per-process map); None means compression is off."""
+    if residuals is None:
+        return []
+    return sorted(residuals.items())
+
+
+def _pack_residuals(arrays: dict, residuals) -> None:
+    # error-feedback residuals (compress/feedback.py): worker state the
+    # same way the buffers are — a resume must carry the exact residual
+    # the crash interrupted, or the compressed stream replays biased
+    for w, ef in _residual_items(residuals):
+        arrays[f"ef{w}_residual"] = ef.state()
+
+
+def _unpack_residuals(z, residuals) -> None:
+    for w, ef in _residual_items(residuals):
+        if f"ef{w}_residual" in z.files:
+            ef.restore(z[f"ef{w}_residual"])
+
+
 def _atomic_savez(path: str, arrays: dict) -> None:
     tmp = path + ".tmp.npz"
     np.savez(tmp, **arrays)
     os.replace(tmp, path)
 
 
-def save(path: str, server, buffers=None, log_offsets=None) -> None:
+def save(path: str, server, buffers=None, log_offsets=None,
+         residuals=None) -> None:
     arrays = dict(
         theta=server.theta,
         clocks=np.asarray(server.tracker.clocks, dtype=np.int64),
@@ -79,10 +102,11 @@ def save(path: str, server, buffers=None, log_offsets=None) -> None:
         # exactly these (log/durable_fabric.recover)
         arrays["log_offsets"] = np.asarray(json.dumps(log_offsets))
     _pack_buffers(arrays, buffers)
+    _pack_residuals(arrays, residuals)
     _atomic_savez(path, arrays)
 
 
-def restore(path: str, server, buffers=None) -> None:
+def restore(path: str, server, buffers=None, residuals=None) -> None:
     with np.load(path) as z:
         if z["theta"].shape != server.theta.shape:
             raise ValueError(
@@ -108,6 +132,7 @@ def restore(path: str, server, buffers=None) -> None:
                 k: int(v) for k, v
                 in json.loads(str(z["log_offsets"])).items()}
         _unpack_buffers(z, buffers)
+        _unpack_residuals(z, residuals)
     # the crash killed every in-flight message; start_training_loop
     # re-SENDS each worker's current clock (at-least-once redelivery,
     # like Kafka's uncommitted-offset replay on rebalance), and a crash
@@ -119,9 +144,9 @@ def restore(path: str, server, buffers=None) -> None:
     server.record_membership_event("resume", -1)
 
 
-def maybe_restore(path: str, server, buffers=None) -> bool:
+def maybe_restore(path: str, server, buffers=None, residuals=None) -> bool:
     if os.path.exists(path):
-        restore(path, server, buffers=buffers)
+        restore(path, server, buffers=buffers, residuals=residuals)
         return True
     return False
 
@@ -146,18 +171,21 @@ def worker_state_path(checkpoint: str, worker_ids) -> str:
     return f"{checkpoint}.workers-{tag}.npz"
 
 
-def save_worker(path: str, buffers, run_id: int = 0) -> None:
+def save_worker(path: str, buffers, run_id: int = 0,
+                residuals=None) -> None:
     arrays: dict = {"_worker_state": np.asarray(1, dtype=np.int64),
                     "run_id": np.asarray(run_id, dtype=np.int64)}
     _pack_buffers(arrays, buffers)
+    _pack_residuals(arrays, residuals)
     _atomic_savez(path, arrays)
 
 
-def maybe_restore_worker(path: str, buffers,
-                         run_id: int | None = None) -> bool:
-    """Restore the buffers — unless `run_id` is given and the file was
-    written under a DIFFERENT run (a stale leftover: restoring it would
-    seed a fresh run with another run's training window)."""
+def maybe_restore_worker(path: str, buffers, run_id: int | None = None,
+                         residuals=None) -> bool:
+    """Restore the buffers (and, when compression is on, the
+    error-feedback residuals) — unless `run_id` is given and the file
+    was written under a DIFFERENT run (a stale leftover: restoring it
+    would seed a fresh run with another run's training window)."""
     if not os.path.exists(path):
         return False
     with np.load(path) as z:
@@ -165,4 +193,6 @@ def maybe_restore_worker(path: str, buffers,
             stored = int(z["run_id"]) if "run_id" in z.files else None
             if stored != run_id:
                 return False
-        return _unpack_buffers(z, buffers)
+        found = _unpack_buffers(z, buffers)
+        _unpack_residuals(z, residuals)
+        return found

@@ -105,6 +105,14 @@ class PSConfig:
     # path.  In-process fabrics only — socket mode forces it off (the
     # wire protocol has no gang notice frame).
     use_gang: bool = True
+    # Compressed delta transport (kafka_ps_tpu/compress/,
+    # docs/COMPRESSION.md): "none" | "bf16" | "int8" | "topk:<ratio>".
+    # Applied symmetrically — server->worker weights are quantize-
+    # dequantized, worker->server deltas go through per-worker
+    # error-feedback residuals.  "none" is bitwise-identical to a build
+    # without the feature.  Incompatible with the fused BSP path (its
+    # collectives never cross a serde boundary).
+    compress: str = "none"
     # Online serving plane (kafka_ps_tpu/serving/): disabled by default —
     # attaching it never perturbs training (snapshots alias the
     # immutable device theta), but the engine thread only exists when

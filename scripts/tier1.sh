@@ -13,7 +13,113 @@
 # tiny model with serving enabled, predict in-process AND over the
 # socket (PredictClient), and assert the staleness rejection path fires
 # (docs/SERVING.md).
+#
+# `scripts/tier1.sh --compress` runs the compressed-transport smoke leg:
+# socket mode end-to-end under --compress int8 — HELLO codec
+# negotiation, batched T_DATA_BATCH ingest, error-feedback training to
+# completion, and strictly fewer bytes on the wire than the
+# uncompressed arm (docs/COMPRESSION.md).
 set -o pipefail
+
+if [[ "${1:-}" == "--compress" ]]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
+import threading
+import numpy as np
+from kafka_ps_tpu.compress import wire as cwire
+from kafka_ps_tpu.data.buffer import SlidingBuffer
+from kafka_ps_tpu.data.synth import generate_hard
+from kafka_ps_tpu.runtime import fabric as fabric_mod, net
+from kafka_ps_tpu.runtime.server import ServerNode
+from kafka_ps_tpu.runtime.worker import WorkerNode
+from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig, PSConfig)
+from kafka_ps_tpu.utils.csvlog import NullLogSink
+
+model = ModelConfig(num_features=64, num_classes=2)
+x, y = generate_hard(512 + 500, num_features=64, num_classes=2, seed=9)
+test_x, test_y = x[-500:], y[-500:]
+
+def run(compress, iters=24):
+    ids = [0, 1]
+    cfg = PSConfig(num_workers=2, consistency_model=0, model=model,
+                   buffer=BufferConfig(min_size=32, max_size=256),
+                   eval_every=10**9, use_gang=False, compress=compress)
+    spec = cwire.parse_codec(compress)
+    sbridge = net.ServerBridge(port=0, run_id=1, codec=spec)
+    sfabric = sbridge.wrap(fabric_mod.Fabric())
+    server = ServerNode(cfg, sfabric, test_x, test_y, NullLogSink())
+    wbridge = net.WorkerBridge("127.0.0.1", sbridge.port, ids, codec=spec)
+    wfabric = wbridge.make_fabric()
+    buffers = {w: SlidingBuffer(64, cfg.buffer) for w in ids}
+    nodes = {w: WorkerNode(w, cfg, wfabric, buffers[w], test_x, test_y,
+                           NullLogSink()) for w in ids}
+    if wbridge.negotiated.codec_id != net.CODEC_NONE:
+        from kafka_ps_tpu import compress as comp
+        codec = comp.get_codec(wbridge.negotiated, server.task.num_params)
+        server.compressor = comp.WeightsCompressor(codec)
+        for w in ids:
+            nodes[w].compressor = comp.ErrorFeedback(codec)
+    reader = threading.Thread(target=wbridge.run_reader, args=(buffers,),
+                              daemon=True)
+    reader.start()
+    sbridge.wait_for_connected(ids, timeout=30)
+    # batched ingest end-to-end: rows cross as ONE T_DATA_BATCH frame
+    # and land via SlidingBuffer.add_many
+    for w in ids:
+        rows = [(dict(enumerate(x[i])), int(y[i]))
+                for i in range(w, 512, 2)]
+        assert sbridge.send_data_batch(w, rows), "batch send failed"
+    deadline = 30.0
+    import time
+    t0 = time.monotonic()
+    while any(buffers[w].count == 0 for w in ids):
+        if time.monotonic() - t0 > deadline:
+            raise AssertionError("batched rows never arrived")
+        time.sleep(0.01)
+    for w in ids:
+        wbridge.mark_ready(w)
+    sbridge.wait_for_workers(ids, timeout=30)
+    stop = threading.Event()
+    def worker_loop(node):
+        try:
+            while not stop.is_set():
+                m = wfabric.poll_blocking(fabric_mod.WEIGHTS_TOPIC,
+                                          node.worker_id, timeout=0.05)
+                if m is not None:
+                    node.on_weights(m)
+        except (ConnectionError, OSError):
+            pass
+    ts = [threading.Thread(target=worker_loop, args=(nodes[w],),
+                           daemon=True) for w in ids]
+    for t in ts:
+        t.start()
+    server.start_training_loop()
+    while server.iterations < iters:
+        g = sfabric.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0,
+                                  timeout=0.2)
+        if g is not None:
+            server.process(g)
+    stop.set()
+    sbridge.close()
+    for t in ts:
+        t.join(timeout=120)
+    wbridge.close()
+    reader.join(timeout=10)
+    server.log.close()
+    wire = (sbridge.wire_bytes.get(net.T_WEIGHTS, 0)
+            + sbridge.wire_bytes.get(net.T_GRADIENTS, 0))
+    return wbridge.negotiated.name, server.iterations, wire
+
+neg8, it8, wire8 = run("int8")
+assert neg8 == "int8", f"negotiation failed: {neg8}"
+assert it8 >= 24, it8
+neg0, it0, wire0 = run("none")
+assert neg0 == "none", neg0
+assert wire8 < wire0 / 2, (wire8, wire0)
+print(f"COMPRESS_SMOKE_OK int8_wire={wire8} none_wire={wire0} "
+      f"ratio={wire0 / wire8:.2f} iters={it8}")
+EOF
+    exit $?
+fi
 
 if [[ "${1:-}" == "--serve" ]]; then
     timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'

@@ -1,0 +1,280 @@
+"""Compressed delta transport (kafka_ps_tpu/compress/,
+docs/COMPRESSION.md): codec round-trip error bounds, host pack/unpack
+bit-exactness, error-feedback signal preservation, serde wire frames
+for the compressed type ids (including the idempotent re-serialization
+the durable log depends on), and the CLI's --fused exclusion.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+import pytest
+
+from kafka_ps_tpu import compress
+from kafka_ps_tpu.compress import wire as cwire
+from kafka_ps_tpu.runtime import serde
+from kafka_ps_tpu.runtime.messages import (EncodedValues, GradientMessage,
+                                           KeyRange, WeightsMessage)
+
+N = 6150        # the reference model shape (utils/config.ModelConfig)
+
+
+def _vec(n=N, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(n) * scale).astype(np.float32)
+
+
+# -- codec spec parsing ------------------------------------------------------
+
+
+def test_parse_codec_accepts_the_flag_surface():
+    assert cwire.parse_codec("none") == cwire.NONE
+    assert cwire.parse_codec("bf16").codec_id == cwire.CODEC_BF16
+    assert cwire.parse_codec("int8").codec_id == cwire.CODEC_INT8
+    spec = cwire.parse_codec("topk:0.25")
+    assert spec.codec_id == cwire.CODEC_TOPK
+    assert spec.param == pytest.approx(0.25)
+    assert spec.spec_str() == "topk:0.25"
+
+
+@pytest.mark.parametrize("bad", ["gzip", "topk", "topk:0", "topk:1.5",
+                                 "topk:-0.1", "topk:x", "int8:2"])
+def test_parse_codec_rejects_garbage(bad):
+    with pytest.raises(ValueError):
+        cwire.parse_codec(bad)
+
+
+def test_codec_spec_param_survives_f32_wire_roundtrip():
+    """Negotiation equality: the HELLO trailer carries the param as
+    float32, and the spec that comes back must compare EQUAL to the one
+    that went out (CodecSpec canonicalizes through float32)."""
+    spec = cwire.parse_codec("topk:0.1")
+    packed = struct.pack("<f", spec.param)
+    back = cwire.CodecSpec(spec.codec_id, struct.unpack("<f", packed)[0])
+    assert back == spec
+
+
+# -- device codec round-trip error bounds ------------------------------------
+
+
+def test_bf16_roundtrip_error_bound():
+    v = _vec()
+    codec = compress.get_codec(cwire.parse_codec("bf16"), N)
+    decoded = np.asarray(codec.decode(*codec.encode(v)))
+    # bf16 keeps 8 significand bits: relative error <= 2^-8 per element
+    np.testing.assert_allclose(decoded, v, rtol=2.0 ** -8)
+
+
+def test_int8_roundtrip_error_bound():
+    v = _vec()
+    codec = compress.get_codec(cwire.parse_codec("int8"), N)
+    decoded = np.asarray(codec.decode(*codec.encode(v)))
+    # uniform quantization at scale max|chunk|/127: absolute error per
+    # element <= its chunk's scale; bound globally by the coarsest chunk
+    bound = float(np.abs(v).max()) / 127.0
+    assert float(np.abs(decoded - v).max()) <= bound + 1e-7
+
+
+def test_topk_keeps_exactly_the_largest_entries():
+    v = _vec(n=1000)
+    spec = cwire.parse_codec("topk:0.1")
+    codec = compress.get_codec(spec, 1000)
+    decoded = np.asarray(codec.decode(*codec.encode(v)))
+    kept = np.flatnonzero(decoded)
+    assert len(kept) == cwire.topk_k(spec.param, 1000) == 100
+    # kept entries pass through EXACTLY, and they are the largest-|v|
+    np.testing.assert_array_equal(decoded[kept], v[kept])
+    assert np.abs(v[kept]).min() >= np.abs(
+        np.delete(v, kept)).max() - 1e-7
+
+
+def test_zero_vector_all_codecs():
+    """The int8 zero-chunk guard (scale 0 -> divide-by-zero) and the
+    general all-zero case decode back to exact zeros."""
+    z = np.zeros(N, np.float32)
+    for name in ("bf16", "int8", "topk:0.1"):
+        codec = compress.get_codec(cwire.parse_codec(name), N)
+        decoded = np.asarray(codec.decode(*codec.encode(z)))
+        np.testing.assert_array_equal(decoded, z)
+
+
+# -- host wire pack/unpack ---------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["bf16", "int8", "topk:0.1"])
+def test_pack_unpack_is_exact_inverse(name):
+    """The sender's device parts survive the host blob bitwise, so both
+    ends decode to IDENTICAL floats — the invariant error feedback and
+    durable replay rest on."""
+    v = _vec(seed=3)
+    spec = cwire.parse_codec(name)
+    codec = compress.get_codec(spec, N)
+    parts = [np.asarray(p) for p in codec.encode(v)]
+    flags, aux, blob = cwire.pack_parts(spec.codec_id, parts, N)
+    back = cwire.unpack_parts(spec.codec_id, flags, aux, blob, N)
+    assert len(back) == len(parts)
+    for a, b in zip(parts, back):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    d1 = np.asarray(codec.decode(*parts))
+    d2 = np.asarray(codec.decode(*back))
+    assert d1.tobytes() == d2.tobytes()
+
+
+def test_int8_wire_ratio_meets_the_4x_bound():
+    """Acceptance criterion: int8 (with its lossless zlib stage) must
+    cut the 4n-byte float payload by >= 4x at the reference shape."""
+    v = _vec(seed=4)
+    spec = cwire.parse_codec("int8")
+    codec = compress.get_codec(spec, N)
+    parts = [np.asarray(p) for p in codec.encode(v)]
+    _, _, blob = cwire.pack_parts(spec.codec_id, parts, N)
+    assert 4.0 * N / len(blob) >= 4.0, len(blob)
+
+
+# -- error feedback ----------------------------------------------------------
+
+
+def test_error_feedback_preserves_the_accumulated_signal():
+    """sum(sent deltas) + residual == sum(true deltas): quantization
+    error is carried, never dropped — the convergence property of
+    EF-compressed SGD (docs/COMPRESSION.md)."""
+    codec = compress.get_codec(cwire.parse_codec("int8"), N)
+    ef = compress.ErrorFeedback(codec)
+    rng = np.random.default_rng(7)
+    total_true = np.zeros(N, np.float64)
+    total_sent = np.zeros(N, np.float64)
+    for _ in range(50):
+        delta = (rng.standard_normal(N) * 0.1).astype(np.float32)
+        decoded, _ = ef.step(delta)
+        total_true += delta
+        total_sent += np.asarray(decoded)
+    drift = np.abs(total_sent + np.asarray(ef.state()) - total_true).max()
+    assert drift < 1e-3, drift
+    # and the residual is genuinely nonzero (int8 loses bits every step)
+    assert np.abs(np.asarray(ef.state())).max() > 0
+
+
+def test_error_feedback_state_roundtrip():
+    codec = compress.get_codec(cwire.parse_codec("int8"), N)
+    ef = compress.ErrorFeedback(codec)
+    ef.step(_vec(seed=8))
+    saved = ef.state()
+    ef2 = compress.ErrorFeedback(codec)
+    ef2.restore(saved)
+    np.testing.assert_array_equal(np.asarray(ef2.residual),
+                                  np.asarray(ef.residual))
+    # identical next step from identical state
+    d = _vec(seed=9)
+    a, _ = ef.step(d)
+    b, _ = ef2.step(d)
+    assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_weights_compressor_identity_cache():
+    """The gate releases the SAME theta object to many workers at one
+    moment — the second encode must be the cached one (no new arrays)."""
+    import jax.numpy as jnp
+    codec = compress.get_codec(cwire.parse_codec("int8"), N)
+    wc = compress.WeightsCompressor(codec)
+    theta = jnp.asarray(_vec(seed=10))
+    d1, e1 = wc.encode(theta)
+    d2, e2 = wc.encode(theta)
+    assert d1 is d2 and e1 is e2
+    d3, _ = wc.encode(jnp.asarray(_vec(seed=11)))
+    assert d3 is not d1
+
+
+# -- serde wire frames (type ids 4/5) ----------------------------------------
+
+
+def _compressed_gradient(name="int8", seed=5):
+    codec = compress.get_codec(cwire.parse_codec(name), N)
+    ef = compress.ErrorFeedback(codec)
+    decoded, enc = ef.step(_vec(seed=seed))
+    return GradientMessage(vector_clock=3, key_range=KeyRange(0, N),
+                           values=decoded, encoded=enc, worker_id=2)
+
+
+@pytest.mark.parametrize("name", ["bf16", "int8", "topk:0.1"])
+def test_serde_compressed_gradient_roundtrip(name):
+    msg = _compressed_gradient(name)
+    got = serde.from_bytes(serde.to_bytes(msg))
+    assert isinstance(got, GradientMessage)
+    assert (got.vector_clock, got.worker_id) == (3, 2)
+    assert got.key_range == KeyRange(0, N)
+    # the receiver's decoded values are bitwise the sender's
+    assert np.asarray(got.values).tobytes() == \
+        np.asarray(msg.values).tobytes()
+    assert got.encoded is not None
+    assert got.encoded.codec_id == cwire.parse_codec(name).codec_id
+
+
+def test_serde_compressed_reserialization_is_byte_identical():
+    """Durable-log safety: a decoded compressed frame re-serializes to
+    the EXACT bytes (serde never re-encodes — int8 quantization is not
+    idempotent, a re-encode would desync the error-feedback residuals)."""
+    b1 = serde.to_bytes(_compressed_gradient())
+    b2 = serde.to_bytes(serde.from_bytes(b1))
+    assert b1 == b2
+
+
+def test_serde_compressed_weights_roundtrip():
+    codec = compress.get_codec(cwire.parse_codec("int8"), N)
+    wc = compress.WeightsCompressor(codec)
+    decoded, enc = wc.encode(_vec(seed=6))
+    msg = WeightsMessage(vector_clock=7, key_range=KeyRange(0, N),
+                         values=decoded, encoded=enc)
+    got = serde.from_bytes(serde.to_bytes(msg))
+    assert isinstance(got, WeightsMessage)
+    assert got.vector_clock == 7
+    assert np.asarray(got.values).tobytes() == \
+        np.asarray(msg.values).tobytes()
+
+
+def test_compressed_frames_are_smaller_and_plain_frames_unchanged():
+    """int8 cuts the gradient frame >= 4x; a message WITHOUT `encoded`
+    emits the legacy type id and payload — `--compress none` stays
+    bitwise-identical to a build without the feature."""
+    plain = GradientMessage(vector_clock=3, key_range=KeyRange(0, N),
+                            values=_vec(seed=5), worker_id=2)
+    plain_bytes = serde.to_bytes(plain)
+    assert plain_bytes[4] == 2            # legacy GradientMessage tid
+    comp_bytes = serde.to_bytes(_compressed_gradient())
+    assert comp_bytes[4] == 5             # CompressedGradient tid
+    assert len(plain_bytes) >= 4 * len(comp_bytes)
+
+
+def test_make_compressor_none_is_none():
+    assert compress.make_compressor("none", N) is None
+    assert compress.make_compressor("int8", N) is not None
+
+
+def test_encoded_values_is_transport_only_metadata():
+    """Messages always carry full-precision decoded `values`; `encoded`
+    defaults to None so every pre-compression construction site is
+    unchanged."""
+    msg = GradientMessage(vector_clock=0, key_range=KeyRange(0, 3),
+                          values=np.zeros(3, np.float32), worker_id=1)
+    assert msg.encoded is None
+    enc = EncodedValues(codec_id=cwire.CODEC_INT8, param=0.0, parts=())
+    assert (enc.codec_id, enc.parts) == (cwire.CODEC_INT8, ())
+
+
+# -- CLI exclusions ----------------------------------------------------------
+
+
+def test_fused_plus_compress_is_rejected():
+    from kafka_ps_tpu.cli import run as run_mod
+    args = run_mod.build_parser().parse_args(
+        ["--fused", "--compress", "int8"])
+    with pytest.raises(SystemExit, match="serde boundary"):
+        run_mod.run_with_args(args)
+
+
+def test_bad_compress_spec_is_rejected():
+    from kafka_ps_tpu.cli import run as run_mod
+    args = run_mod.build_parser().parse_args(["--compress", "topk:9"])
+    with pytest.raises(SystemExit, match="--compress"):
+        run_mod.run_with_args(args)

@@ -32,13 +32,14 @@ from kafka_ps_tpu.utils.trace import Tracer
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def small_cfg(num_workers=4):
+def small_cfg(num_workers=4, compress="none"):
     return PSConfig(
         num_workers=num_workers,
         consistency_model=0,
         model=ModelConfig(num_features=8, num_classes=2),
         buffer=BufferConfig(min_size=8, max_size=32),
         stream=StreamConfig(time_per_event_ms=1.0),
+        compress=compress,
     )
 
 
@@ -50,8 +51,8 @@ def make_dataset(n=256, f=8, seed=0):
     return x, y
 
 
-def build_app(fabric=None, tracer=None):
-    cfg = small_cfg()
+def build_app(fabric=None, tracer=None, compress="none"):
+    cfg = small_cfg(compress=compress)
     x, y = make_dataset()
     app = StreamingPSApp(cfg, test_x=x, test_y=y, tracer=tracer,
                          fabric=fabric)
@@ -114,6 +115,58 @@ def test_server_restart_replays_to_identical_theta(tmp_path):
     # exactly-once: recomputed gradients for already-applied clocks were
     # redeliveries and the tracker's clock filter dropped every one
     assert tracer.counters().get("server.duplicate_gradients_dropped", 0) > 0
+
+
+def test_compressed_restart_replays_to_identical_theta(tmp_path):
+    """The server-restart replay test under --compress int8: the
+    error-feedback residuals are recoverable state — the checkpoint
+    carries one per worker (utils/checkpoint._pack_residuals), the
+    durable log replays the exact compressed frames (serde re-emits the
+    encoded parts verbatim), and the restarted run finishes bitwise-
+    identical to the uninterrupted compressed baseline."""
+    x, y = make_dataset()
+
+    base = build_app(compress="int8")
+    fill(base, x, y)
+    base.run_serial(max_server_iterations=40)
+    theta_base = np.asarray(base.server.theta)
+
+    log_dir = str(tmp_path / "wal")
+    ck_path = str(tmp_path / "ck.npz")
+    app1 = build_app(fabric=DurableFabric(log_dir, LogConfig(fsync="none")),
+                     compress="int8")
+    app1.server.checkpoint_path = ck_path
+    app1.server.checkpoint_every = 16
+    app1.server.checkpoint_buffers = app1.buffers
+    fill(app1, x, y)
+    app1.run_serial(max_server_iterations=24)
+    with np.load(ck_path) as z:
+        for w in range(app1.cfg.num_workers):
+            assert f"ef{w}_residual" in z.files
+        # int8 on real deltas always leaves quantization residue
+        assert np.abs(z["ef0_residual"]).max() > 0
+    # SIGKILL simulation: abandoned — no close, no final save
+
+    app2 = build_app(fabric=DurableFabric(log_dir, LogConfig(fsync="none")),
+                     compress="int8")
+    app2.server.checkpoint_path = ck_path
+    app2.server.checkpoint_every = 16
+    app2.server.checkpoint_buffers = app2.buffers
+    assert ckpt.maybe_restore(ck_path, app2.server, buffers=app2.buffers,
+                              residuals=app2.compressors)
+    # the restored residuals are exactly the committed ones
+    with np.load(ck_path) as z:
+        np.testing.assert_array_equal(
+            np.asarray(app2.compressors[0].residual), z["ef0_residual"])
+    app2.recover_durable()
+    app2.run_serial(max_server_iterations=40)
+    np.testing.assert_array_equal(np.asarray(app2.server.theta), theta_base)
+    assert app2.server.tracker.clocks == base.server.tracker.clocks
+    # and the post-run residuals agree with the uninterrupted run's
+    for w in range(app2.cfg.num_workers):
+        np.testing.assert_array_equal(
+            np.asarray(app2.compressors[w].residual),
+            np.asarray(base.compressors[w].residual))
 
 
 def test_recovery_without_checkpoint_is_full_replay(tmp_path):
@@ -286,13 +339,15 @@ def _env() -> dict:
 
 
 @pytest.mark.slow
-def test_sigkill_restart_matches_uninterrupted_run(tmp_path):
+@pytest.mark.parametrize("compress", ["none", "int8"])
+def test_sigkill_restart_matches_uninterrupted_run(tmp_path, compress):
     """SIGKILL the in-process job mid-run; restart with the same
     --durable-log and --checkpoint: it must replay from the committed
     offsets and finish with the exact final theta and clocks of an
     uninterrupted run.  The dataset (512 rows = 4 workers x 128 prefill)
     prefills entirely before training, so serial mode is bitwise
-    deterministic."""
+    deterministic.  The int8 variant additionally proves the error-
+    feedback residuals ride the checkpoint through a real SIGKILL."""
     from kafka_ps_tpu.data.synth import generate, write_csv
     x, y = generate(632, 16, 3, noise=1.0, sparsity=0.5, seed=0)
     write_csv(str(tmp_path / "train.csv"), x[:512], y[:512])
@@ -307,7 +362,7 @@ def test_sigkill_restart_matches_uninterrupted_run(tmp_path):
                 "--num_workers", "4", "--mode", "serial", "-p", "2",
                 "--eval_every", "10", "--max_iterations", "160",
                 "--checkpoint", ck, "--checkpoint_every", "20",
-                "-v"] + extra
+                "--compress", compress, "-v"] + extra
 
     # uninterrupted baseline (volatile fabric: the flagless path must
     # behave identically, acceptance criterion)

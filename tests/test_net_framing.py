@@ -217,6 +217,181 @@ def test_config_frame_roundtrip():
     a.close(), b.close()
 
 
+# -- zero-copy frame payloads ------------------------------------------------
+
+
+def test_recv_frame_payload_is_a_memoryview():
+    """recv_frame hands out a view into the one recv buffer — decode
+    sites (np.frombuffer, struct.unpack_from, zlib) consume it without
+    an extra per-frame copy."""
+    a, b = _pair()
+    net.send_frame(a, net.T_WEIGHTS, 1, b"abcdef")
+    topic, key, payload = net.recv_frame(b)
+    assert isinstance(payload, memoryview)
+    assert bytes(payload) == b"abcdef"
+    assert np.frombuffer(payload, dtype=np.uint8).tobytes() == b"abcdef"
+    a.close(), b.close()
+
+
+# -- HELLO codec negotiation (docs/COMPRESSION.md) ---------------------------
+
+
+def _codec_spec(name):
+    from kafka_ps_tpu.compress import wire as cwire
+    return cwire.parse_codec(name)
+
+
+def test_codec_negotiation_matching_specs():
+    spec = _codec_spec("int8")
+    bridge = net.ServerBridge(codec=spec)
+    worker = net.WorkerBridge("127.0.0.1", bridge.port, [0], codec=spec)
+    assert worker.negotiated == spec
+    worker.close(), bridge.close()
+
+
+def test_codec_negotiation_param_must_match_too():
+    bridge = net.ServerBridge(codec=_codec_spec("topk:0.1"))
+    worker = net.WorkerBridge("127.0.0.1", bridge.port, [0],
+                              codec=_codec_spec("topk:0.1"))
+    assert worker.negotiated == _codec_spec("topk:0.1")
+    worker.close()
+    worker2 = net.WorkerBridge("127.0.0.1", bridge.port, [0],
+                               codec=_codec_spec("topk:0.5"))
+    assert worker2.negotiated.codec_id == net.CODEC_NONE
+    worker2.close(), bridge.close()
+
+
+def test_codec_negotiation_mismatch_falls_back_to_none():
+    """Mixed fleet: a worker asking for a codec the server doesn't run
+    gets NONE back — both sides ship plain frames, training proceeds."""
+    bridge = net.ServerBridge(codec=_codec_spec("int8"))
+    worker = net.WorkerBridge("127.0.0.1", bridge.port, [0],
+                              codec=_codec_spec("bf16"))
+    assert worker.negotiated.codec_id == net.CODEC_NONE
+    worker.close(), bridge.close()
+
+
+def test_codec_negotiation_uncompressed_server():
+    bridge = net.ServerBridge()          # no codec flag at all
+    worker = net.WorkerBridge("127.0.0.1", bridge.port, [0],
+                              codec=_codec_spec("int8"))
+    assert worker.negotiated.codec_id == net.CODEC_NONE
+    worker.close(), bridge.close()
+
+
+def test_legacy_hello_without_trailer_negotiates_none():
+    """A pre-compression worker's HELLO has no codec trailer: the server
+    must register it (CONFIG comes back) and record NONE for the
+    connection, not choke on the short payload."""
+    bridge = net.ServerBridge(codec=_codec_spec("int8"), run_id=77)
+    sock = socket.create_connection(("127.0.0.1", bridge.port))
+    net.send_frame(sock, net.T_HELLO, 0, struct.pack("<qq", 1, 4))
+    topic, _, payload = net.recv_frame(sock)
+    assert topic == net.T_CONFIG
+    interval, run_id = struct.unpack_from("<dq", payload, 0)
+    assert run_id == 77
+    # the reply's trailer says NONE — the server will not send this
+    # peer compressed frames
+    codec_id, _ = struct.unpack_from("<Bf", payload, 16)
+    assert codec_id == net.CODEC_NONE
+    bridge.wait_for_connected([4], timeout=10.0)
+    sock.close(), bridge.close()
+
+
+def test_worker_tolerates_legacy_16_byte_config():
+    """A pre-compression SERVER replies a bare <dq> CONFIG: the worker
+    handshake must complete with negotiated == NONE."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def fake_server():
+        conn, _ = srv.accept()
+        while True:
+            frame = net.recv_frame(conn)
+            if frame is None:
+                break
+            topic, _, _ = frame
+            if topic == net.T_HELLO:
+                net.send_frame(conn, net.T_CONFIG, 0,
+                               struct.pack("<dq", 0.0, 55))
+
+    t = threading.Thread(target=fake_server, daemon=True)
+    t.start()
+    worker = net.WorkerBridge("127.0.0.1", port, [0],
+                              codec=_codec_spec("int8"))
+    assert worker.server_run_id == 55
+    assert worker.negotiated.codec_id == net.CODEC_NONE
+    worker.close()
+    srv.close()
+    t.join(timeout=10.0)
+
+
+def test_compressed_weights_downgraded_for_none_peer():
+    """A message carrying `encoded` sent to a connection that negotiated
+    NONE must go out as a PLAIN frame (the decoded f32 values) — the
+    mixed-fleet interop contract."""
+    from kafka_ps_tpu import compress as comp
+    n = 300
+    codec = comp.get_codec(_codec_spec("int8"), n)
+    wc = comp.WeightsCompressor(codec)
+    theta = np.arange(n, dtype=np.float32) / n
+    decoded, enc = wc.encode(theta)
+    msg = WeightsMessage(vector_clock=1, key_range=KeyRange(0, n),
+                         values=decoded, encoded=enc)
+
+    bridge = net.ServerBridge(codec=_codec_spec("int8"))
+    worker = net.WorkerBridge("127.0.0.1", bridge.port, [6])  # no codec
+    assert worker.negotiated.codec_id == net.CODEC_NONE
+    bridge.wait_for_connected([6], timeout=10.0)
+    conn = bridge._conn_of[6]
+    assert bridge._send(conn, net.T_WEIGHTS, 6, msg)
+    topic, _, payload = net.recv_frame(worker._sock)
+    assert topic == net.T_WEIGHTS
+    got = serde.from_bytes(payload)
+    assert got.encoded is None          # plain legacy frame
+    assert np.asarray(got.values).tobytes() == \
+        np.asarray(decoded).tobytes()
+    worker.close(), bridge.close()
+
+
+# -- batched stream ingest (T_DATA_BATCH) ------------------------------------
+
+
+def test_send_data_batch_bulk_inserts_via_add_many():
+    from kafka_ps_tpu.data.buffer import SlidingBuffer
+    from kafka_ps_tpu.utils.config import BufferConfig
+
+    bridge = net.ServerBridge()
+    worker = net.WorkerBridge("127.0.0.1", bridge.port, [2])
+    bridge.wait_for_connected([2], timeout=10.0)
+    buffers = {2: SlidingBuffer(4, BufferConfig(min_size=4, max_size=16))}
+    t = threading.Thread(target=worker.run_reader, args=(buffers,),
+                         daemon=True)
+    t.start()
+    rows = [({0: float(i), 3: 1.0}, i % 2) for i in range(5)]
+    assert bridge.send_data_batch(2, rows)
+    deadline = time.monotonic() + 10.0
+    while buffers[2].count < 5 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert buffers[2].count == 5
+    x, y, mask = buffers[2].snapshot()
+    got = sorted(x[mask > 0][:, 0].tolist())
+    assert got == [0.0, 1.0, 2.0, 3.0, 4.0]
+    # wire accounting: ONE frame crossed for the whole batch
+    assert bridge.wire_bytes.get(net.T_DATA_BATCH, 0) > 0
+    assert bridge.wire_bytes.get(net.T_DATA, 0) == 0
+    worker.close(), bridge.close()
+    t.join(timeout=10.0)
+
+
+def test_send_data_batch_to_unknown_worker_returns_false():
+    bridge = net.ServerBridge()
+    assert not bridge.send_data_batch(9, [({0: 1.0}, 1)])
+    bridge.close()
+
+
 # -- serving-plane payload codecs (docs/SERVING.md) --------------------------
 
 

@@ -157,7 +157,8 @@ def test_gradient_applied_over_partial_key_range():
     app = StreamingPSApp(cfg)
     n = cfg.model.num_params
     g = GradientMessage(0, KeyRange(2, 5), np.asarray([1.0, 1.0, 1.0],
-                                                      np.float32), 0)
+                                                      np.float32),
+                        worker_id=0)
     app.server.process(g)
     expect = np.zeros(n, np.float32)
     expect[2:5] = cfg.server_lr * 1.0
