@@ -1,0 +1,17 @@
+"""Static + runtime invariant checking for kafka_ps_tpu.
+
+- ``pscheck``   — AST analyzer (rules PS100-PS105), stdlib-only;
+  CLI: ``python -m kafka_ps_tpu.analysis kafka_ps_tpu/ [--json]``.
+- ``lockgraph`` — runtime lock-acquisition-order recorder (OrderedLock /
+  OrderedCondition) with deadlock-cycle detection, reported at pytest
+  session end by ``kafka_ps_tpu.analysis.pytest_plugin``.
+
+See docs/ANALYSIS.md for the rule catalog and suppression syntax.
+
+This package must stay importable without jax: the CLI runs in the
+tier-1 ``--analyze`` leg before any accelerator runtime is touched.
+"""
+
+from kafka_ps_tpu.analysis import lockgraph, pscheck  # noqa: F401
+
+__all__ = ["lockgraph", "pscheck"]

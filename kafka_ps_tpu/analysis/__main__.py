@@ -1,0 +1,8 @@
+"""``python -m kafka_ps_tpu.analysis`` — run pscheck over the repo."""
+
+import sys
+
+from kafka_ps_tpu.analysis.pscheck import main
+
+if __name__ == "__main__":
+    sys.exit(main())

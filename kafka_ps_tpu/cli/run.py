@@ -17,7 +17,6 @@ import argparse
 import os
 import sys
 
-import numpy as np
 
 
 def build_parser(include_server_flags: bool = True,

@@ -26,8 +26,8 @@ import sys
 import threading
 import time
 
-import numpy as np
 
+from kafka_ps_tpu.analysis.lockgraph import OrderedLock
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime import net
 
@@ -97,7 +97,7 @@ class _BatchingSink:
         self._max_age = max_age
         self._rows: dict[int, list] = {}
         self._oldest: dict[int, float] = {}   # worker -> first-row time
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("BatchingIngest.rows")
 
     def __call__(self, worker: int, features, label: int) -> None:
         with self._lock:

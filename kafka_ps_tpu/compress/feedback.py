@@ -21,7 +21,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from kafka_ps_tpu.compress.codecs import Codec
-from kafka_ps_tpu.runtime.messages import EncodedValues
 
 
 class ErrorFeedback:

@@ -29,13 +29,13 @@ leak into.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from typing import Callable
 
 import numpy as np
 
+from kafka_ps_tpu.analysis.lockgraph import OrderedLock
 from kafka_ps_tpu.models.logreg import sparse_to_dense
 from kafka_ps_tpu.utils.config import BufferConfig
 
@@ -61,7 +61,7 @@ class SlidingBuffer:
         self._last_arrival_ms: float | None = None
         # add() and snapshot() are internally synchronized so the producer
         # thread and the training loop need no external locking.
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("SlidingBuffer.state")
 
     # -- rate tracking (WorkerSamplingProcessor.java:124-135) --------------
 

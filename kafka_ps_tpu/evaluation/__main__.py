@@ -1,9 +1,11 @@
 """CLI for the offline evaluation subsystem.
 
-  python -m kafka_ps_tpu.evaluation summarize --server logs-server.csv [--worker logs-worker.csv]
+  python -m kafka_ps_tpu.evaluation summarize --server logs-server.csv
+      [--worker logs-worker.csv]
   python -m kafka_ps_tpu.evaluation plot      --server logs-server.csv [--worker ...] --out run.png
   python -m kafka_ps_tpu.evaluation compare   --runs name=path [name=path ...] --out cmp.png
-  python -m kafka_ps_tpu.evaluation validate  --worker logs-worker.csv [--server ...] -c K [--elastic]
+  python -m kafka_ps_tpu.evaluation validate  --worker logs-worker.csv
+      [--server ...] -c K [--elastic]
   python -m kafka_ps_tpu.evaluation ground-truth --train train.csv --test test.csv
 
 Replaces the reference's three Jupyter notebooks (SURVEY §3.4) with

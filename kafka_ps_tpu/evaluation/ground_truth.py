@@ -13,6 +13,7 @@ learning correctly" with the identical hypothesis class.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -32,24 +33,40 @@ class GroundTruth:
     report: str          # sklearn classification_report text
 
 
+# Trace counter for the PS101 regression test (tests/test_evaluation.py):
+# the body runs only when XLA traces, so repeated same-shape calls must
+# leave it unchanged.
+_fit_traces = 0
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "steps"))
+def _fit(theta0, x, y, mask, learning_rate, cfg, steps):
+    global _fit_traces
+    _fit_traces += 1
+
+    def step(theta, _):
+        g, _loss = logreg.grad_loss(theta, x, y, mask, cfg)
+        return theta - learning_rate * g, None
+
+    theta, _ = jax.lax.scan(step, theta0, None, length=steps)
+    return theta
+
+
 def train_offline(train_x: np.ndarray, train_y: np.ndarray,
                   cfg: ModelConfig, *, steps: int = 500,
                   learning_rate: float = 0.5) -> np.ndarray:
     """Full-batch gradient descent to (near-)convergence.  The whole
-    optimization is one lax.scan under jit — a single XLA program."""
+    optimization is one lax.scan under jit — a single XLA program.
+
+    The program is the module-level `_fit` (cached by jit per shape and
+    per static (cfg, steps)): the original closed over the data with a
+    fresh `@jax.jit def fit` per call, which re-traced and re-compiled
+    the whole scan on EVERY oracle evaluation — pscheck PS101."""
     x = jnp.asarray(train_x, jnp.float32)
     y = jnp.asarray(train_y, jnp.int32)
     mask = jnp.ones((x.shape[0],), jnp.float32)
-
-    @jax.jit
-    def fit(theta0):
-        def step(theta, _):
-            g, _loss = logreg.grad_loss(theta, x, y, mask, cfg)
-            return theta - learning_rate * g, None
-        theta, _ = jax.lax.scan(step, theta0, None, length=steps)
-        return theta
-
-    theta = fit(jnp.zeros((cfg.num_params,), jnp.float32))
+    theta = _fit(jnp.zeros((cfg.num_params,), jnp.float32), x, y, mask,
+                 learning_rate, cfg, steps)
     return np.asarray(jax.block_until_ready(theta))
 
 

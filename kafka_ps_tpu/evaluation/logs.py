@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import dataclasses
 
-import numpy as np
 import pandas as pd
 
 SERVER_COLUMNS = ["timestamp", "partition", "vectorClock", "loss",

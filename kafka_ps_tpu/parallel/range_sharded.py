@@ -25,7 +25,6 @@ this is the ZeRO/weight-sharded-DP pattern expressed in shard_map).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import jax

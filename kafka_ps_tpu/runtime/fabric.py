@@ -18,10 +18,10 @@ scheduling.
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 from typing import Any
 
+from kafka_ps_tpu.analysis.lockgraph import OrderedCondition
 from kafka_ps_tpu.utils.trace import NULL_TRACER, Tracer
 
 WEIGHTS_TOPIC = "weights"
@@ -42,7 +42,9 @@ class Fabric:
 
     def __init__(self, tracer: Tracer | None = None):
         self._queues: dict[tuple[str, int], deque] = {}
-        self._cond = threading.Condition()
+        # named per class so DurableFabric orderings get their own node
+        # in the lock-acquisition graph (analysis/lockgraph.py)
+        self._cond = OrderedCondition(f"{type(self).__name__}.cond")
         self._tracer = tracer or NULL_TRACER
 
     def _q(self, topic: str, key: int) -> deque:

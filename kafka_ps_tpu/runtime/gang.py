@@ -36,8 +36,8 @@ simply run solo there; a gang is an optimization, never a barrier.
 from __future__ import annotations
 
 import functools
-import threading
 
+from kafka_ps_tpu.analysis.lockgraph import OrderedLock
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime import worker as worker_mod
 from kafka_ps_tpu.utils.trace import NULL_TRACER
@@ -194,7 +194,7 @@ class GangDispatcher:
         self.fabric = fabric
         self.cfg = cfg
         self.tracer = tracer or NULL_TRACER
-        self._offer_lock = threading.Lock()
+        self._offer_lock = OrderedLock("GangDispatcher.offer")
         # (worker_id, clock) -> the full member tuple of its notice
         self._notices: dict[tuple[int, int], tuple] = {}
         # error-feedback compression needs crash-recovery replay to
@@ -409,5 +409,5 @@ class GangDispatcher:
         # keyed by (worker, clock): a recovery claim can hold TWO
         # messages for one worker (a merged notice spanning releases),
         # and each one's result must reach its own _finish
-        for p, d, l, f1, a in zip(grp, deltas, losses, f1s, accs):
-            results[(p[0].worker_id, p[1].vector_clock)] = (d, l, f1, a)
+        for p, d, loss, f1, a in zip(grp, deltas, losses, f1s, accs):
+            results[(p[0].worker_id, p[1].vector_clock)] = (d, loss, f1, a)

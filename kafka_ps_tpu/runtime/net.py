@@ -54,6 +54,7 @@ import sys
 import threading
 import time
 
+from kafka_ps_tpu.analysis.lockgraph import OrderedLock
 from kafka_ps_tpu.compress.wire import NONE as CODEC_SPEC_NONE
 from kafka_ps_tpu.compress.wire import CODEC_NONE, CodecSpec
 from kafka_ps_tpu.runtime import fabric as fabric_mod
@@ -121,6 +122,17 @@ def send_frame(sock: socket.socket, topic: int, key: int,
                payload: bytes = b"") -> None:
     header = _FRAME.pack(_FRAME.size - 4 + len(payload), topic, key)
     sock.sendall(header + payload)
+
+
+def locked_send(sock: socket.socket, lock, topic: int, key: int,
+                payload: bytes = b"") -> None:
+    """Serialize one frame write onto `sock` under its dedicated write
+    lock.  Interleaved frame bodies from concurrent senders would
+    corrupt the stream, so the write lock's entire critical section IS
+    the write — every bridge sends through here."""
+    with lock:
+        # pscheck: disable=PS105 (dedicated write lock: this send IS the critical section)
+        send_frame(sock, topic, key, payload)
 
 
 def recv_frame(sock: socket.socket) -> tuple[int, int, memoryview] | None:
@@ -224,16 +236,16 @@ class ServerBridge:
         # bytes on the wire per frame topic, both directions, including
         # the 13-byte frame header (the compression_ab bench reads this)
         self.wire_bytes: dict[int, int] = {}
-        self._wire_lock = threading.Lock()
+        self._wire_lock = OrderedLock("ServerBridge.wire")
         self._listener = socket.create_server((host, port))
         self.port = self._listener.getsockname()[1]
         self._conn_of: dict[int, socket.socket] = {}   # worker -> conn
         self._ready: set[int] = set()
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("ServerBridge.state", reentrant=True)
         self._cv = threading.Condition(self._lock)
         self._fabric: fabric_mod.Fabric | None = None
         self._stop = threading.Event()
-        self._send_lock: dict[socket.socket, threading.Lock] = {}
+        self._send_lock: dict[socket.socket, OrderedLock] = {}
         self._last_recv: dict[socket.socket, float] = {}
         self.on_disconnect = None   # Callable[[list[int]], None]
         self.on_hello = None        # Callable[[list[int]], None]
@@ -389,8 +401,7 @@ class ServerBridge:
             self.dropped_sends += count
             return False
         try:
-            with lock:
-                send_frame(conn, topic, key, payload)
+            locked_send(conn, lock, topic, key, payload)
             with self._wire_lock:
                 self.wire_bytes[topic] = (self.wire_bytes.get(topic, 0)
                                           + _FRAME.size + len(payload))
@@ -412,7 +423,7 @@ class ServerBridge:
                 force_close(conn)
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._send_lock[conn] = threading.Lock()
+            self._send_lock[conn] = OrderedLock("ServerBridge.send")
             self._last_recv[conn] = time.monotonic()
             t = threading.Thread(target=self._reader, args=(conn,),
                                  daemon=True, name="kps-net-reader")
@@ -574,7 +585,7 @@ class WorkerBridge:
         self.codec = codec if codec is not None else CODEC_SPEC_NONE
         self.negotiated = CODEC_SPEC_NONE
         self.wire_bytes: dict[int, int] = {}
-        self._wire_lock = threading.Lock()
+        self._wire_lock = OrderedLock("WorkerBridge.wire")
         # retry: the server process may still be importing/binding when
         # this process is already up (both launched together, run.sh-style)
         deadline = time.monotonic() + connect_timeout
@@ -588,7 +599,7 @@ class WorkerBridge:
                     raise
                 time.sleep(0.2)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._send_lock = threading.Lock()
+        self._send_lock = OrderedLock("WorkerBridge.send")
         self._stop = threading.Event()
         self.disconnected = threading.Event()
         self.server_run_id: int | None = None
@@ -596,8 +607,7 @@ class WorkerBridge:
                                len(self.worker_ids), *self.worker_ids)
                    + _CODEC_TRAILER.pack(self.codec.codec_id,
                                          self.codec.param))
-        with self._send_lock:
-            send_frame(self._sock, T_HELLO, 0, payload)
+        locked_send(self._sock, self._send_lock, T_HELLO, 0, payload)
         # synchronous handshake: the server replies T_CONFIG before it
         # registers our ids (net.ServerBridge._reader), so it is the
         # first non-PING frame on the wire — read it HERE, before any
@@ -611,8 +621,7 @@ class WorkerBridge:
                     raise ConnectionError("server closed during handshake")
                 topic, _key, pl = frame
                 if topic == T_PING:
-                    with self._send_lock:
-                        send_frame(self._sock, T_PONG, 0)
+                    locked_send(self._sock, self._send_lock, T_PONG, 0)
                     continue
                 if topic == T_CONFIG:
                     interval, run_id = struct.unpack_from("<dq", pl, 0)
@@ -642,8 +651,8 @@ class WorkerBridge:
             def send(self, topic, key, message):
                 if topic == fabric_mod.GRADIENTS_TOPIC:
                     payload = serde.to_bytes(message)
-                    with bridge._send_lock:
-                        send_frame(bridge._sock, T_GRADIENTS, key, payload)
+                    locked_send(bridge._sock, bridge._send_lock,
+                                T_GRADIENTS, key, payload)
                     with bridge._wire_lock:
                         bridge.wire_bytes[T_GRADIENTS] = (
                             bridge.wire_bytes.get(T_GRADIENTS, 0)
@@ -681,8 +690,7 @@ class WorkerBridge:
         self._sock.settimeout(effective)
 
     def mark_ready(self, worker: int) -> None:
-        with self._send_lock:
-            send_frame(self._sock, T_READY, worker)
+        locked_send(self._sock, self._send_lock, T_READY, worker)
 
     def run_reader(self, buffers: dict[int, object]) -> None:
         """Blocking read loop (call on a dedicated thread or the main
@@ -700,8 +708,7 @@ class WorkerBridge:
                         self.wire_bytes.get(topic, 0)
                         + _FRAME.size + len(payload))
                 if topic == T_PING:
-                    with self._send_lock:
-                        send_frame(self._sock, T_PONG, 0)
+                    locked_send(self._sock, self._send_lock, T_PONG, 0)
                     continue
                 if topic == T_CONFIG:
                     # normally consumed by the constructor handshake;
@@ -754,7 +761,7 @@ class PredictClient:
         self._sock = socket.create_connection((host, port), timeout=5.0)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock.settimeout(timeout)
-        self._send_lock = threading.Lock()
+        self._send_lock = OrderedLock("PredictClient.send")
         self._req = 0
 
     def predict(self, x, min_clock: int | None = None,
@@ -762,9 +769,8 @@ class PredictClient:
         """(label, confidence, vector_clock, wall_time) namedtuple;
         raises serving.policy.StalenessError when the bound rejects."""
         self._req += 1
-        with self._send_lock:
-            send_frame(self._sock, T_PREDICT, self._req,
-                       encode_predict_request(x, min_clock, max_age_s))
+        locked_send(self._sock, self._send_lock, T_PREDICT, self._req,
+                    encode_predict_request(x, min_clock, max_age_s))
         while True:
             frame = recv_frame(self._sock)
             if frame is None:
@@ -772,8 +778,7 @@ class PredictClient:
                     "server closed before the prediction arrived")
             topic, key, payload = frame
             if topic == T_PING:
-                with self._send_lock:
-                    send_frame(self._sock, T_PONG, 0)
+                locked_send(self._sock, self._send_lock, T_PONG, 0)
                 continue
             if topic != T_PREDICTION or key != self._req:
                 continue            # stray control frame (e.g. CONFIG)

@@ -189,6 +189,7 @@ class ServerNode:
         # device theta is immutable — safe to alias; a host-side theta
         # (checkpoint restore, partial-range splice) is copied so a
         # later in-place edit can't race an in-flight message
+        # pscheck: disable=PS102 (host->host defensive copy, no device sync)
         values = (np.array(self.theta)
                   if isinstance(self.theta, np.ndarray) else self.theta)
         encoded = None
@@ -370,9 +371,10 @@ class ServerNode:
                                                   msg.values)
                 self.tracer.count("dispatch.device")
             else:
+                # pscheck: disable=PS102 (KeyRange splice is the documented host path)
                 host = np.array(self.theta)
-                host[r.start:r.end] += (self.cfg.server_lr
-                                        * np.asarray(msg.values))
+                # pscheck: disable=PS102 (KeyRange splice is the documented host path)
+                host[r.start:r.end] += self.cfg.server_lr * np.asarray(msg.values)
                 self.theta = host
             self.iterations += 1
 

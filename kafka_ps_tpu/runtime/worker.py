@@ -143,7 +143,9 @@ class WorkerNode:
         if r.start == 0 and r.end == self.task.num_params:
             self.theta = jnp.asarray(msg.values)
         else:
+            # pscheck: disable=PS102 (KeyRange splice is the documented host path)
             host = np.array(self.theta)
+            # pscheck: disable=PS102 (KeyRange splice is the documented host path)
             host[r.start:r.end] = np.asarray(msg.values)
             self.theta = host
 

@@ -83,6 +83,7 @@ class PredictionEngine:
         request. Never blocks the caller."""
         if self._closed:
             raise RuntimeError("prediction engine is closed")
+        # pscheck: disable=PS102 (client boundary: coerces caller-supplied x)
         row = np.asarray(x, dtype=np.float32).reshape(-1)
         self._q.put(_Request(row, bound, callback, time.monotonic()))
 
@@ -161,6 +162,7 @@ class PredictionEngine:
         self.batched_rows += len(live)
         self.tracer.count("serving.batch_dispatches")
         for i, req in enumerate(live):
+            # pscheck: disable=PS102 (labels/confs are host arrays by here)
             self._finish(req, Prediction(int(labels[i]), float(confs[i]),
                                          snap.vector_clock, snap.wall_time))
 
@@ -173,8 +175,8 @@ class PredictionEngine:
         with self.tracer.span("serving.predict", rows=len(live)):
             labels, confs = fn(theta, xs)
             # block so latency samples measure real service time
-            labels = np.asarray(labels)
-            confs = np.asarray(confs)
+            labels = np.asarray(labels)  # pscheck: disable=PS102 (deliberate latency-sample sync)
+            confs = np.asarray(confs)  # pscheck: disable=PS102 (deliberate latency-sample sync)
         return labels, confs
 
     def _predict_fn(self):

@@ -20,10 +20,10 @@ audit reads (`at_clock`); older ones fall off and become unreachable.
 from __future__ import annotations
 
 import collections
-import threading
 import time
 from typing import NamedTuple
 
+from kafka_ps_tpu.analysis.lockgraph import OrderedLock
 from kafka_ps_tpu.serving import policy
 
 
@@ -43,7 +43,7 @@ class SnapshotRegistry:
         self._latest: Snapshot | None = None
         self._seq = 0
         self._now = now
-        self._publish_lock = threading.Lock()
+        self._publish_lock = OrderedLock("SnapshotRegistry.publish")
 
     def publish(self, theta, vector_clock: int,
                 wall_time: float | None = None) -> Snapshot:

@@ -37,6 +37,8 @@ import sys
 import threading
 from collections import deque
 
+from kafka_ps_tpu.analysis.lockgraph import OrderedCondition, OrderedLock
+
 
 @functools.lru_cache(maxsize=None)
 def _stacker(n: int):
@@ -92,13 +94,13 @@ class DeferredSink:
         self._max_pending = max_pending
         self._interval = drain_interval
         self._idle_exit = idle_exit
-        self._lock = threading.Lock()        # guards _pending + tickets
+        self._lock = OrderedLock("DeferredSink.pending")  # guards _pending + tickets
         # emission turnstile: tickets are taken under _lock, atomically
         # with popping the entries they cover, so ticket order == entry
         # order; emission happens strictly in ticket order but the
         # formatting (device fetches) between take and emit runs
         # unlocked and concurrent
-        self._turn_cv = threading.Condition()
+        self._turn_cv = OrderedCondition("DeferredSink.turn")
         self._next_ticket = 0
         self._turn = 0
         self._wake = threading.Event()

@@ -10,7 +10,8 @@ logs):
 from __future__ import annotations
 
 import sys
-import threading
+
+from kafka_ps_tpu.analysis.lockgraph import OrderedLock
 
 SERVER_HEADER = "timestamp;partition;vectorClock;loss;fMeasure;accuracy"
 WORKER_HEADER = SERVER_HEADER + ";numTuplesSeen"
@@ -40,7 +41,7 @@ class CsvLogSink:
 
     def __init__(self, path: str | None, header: str, append: bool = False):
         import os
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("CsvLogSink.write")
         if path is None:
             self._fh = sys.stdout
             self._close = False

@@ -4,8 +4,9 @@
 # broker; here one process hosts the whole system on the TPU.
 set -e
 
-# fail fast on syntax errors anywhere in the package before launching
-python -m compileall -q kafka_ps_tpu
+# fail fast on syntax errors anywhere in the package (analysis/ and all
+# subsystems) and the test tree before launching
+python -m compileall -q kafka_ps_tpu tests
 
 if [ ! -f ./data/train.csv ]; then
   echo "generating synthetic fine-food-shaped dataset into ./data"

@@ -19,7 +19,23 @@
 # negotiation, batched T_DATA_BATCH ingest, error-feedback training to
 # completion, and strictly fewer bytes on the wire than the
 # uncompressed arm (docs/COMPRESSION.md).
+#
+# `scripts/tier1.sh --analyze` runs the static-analysis leg: pscheck
+# (docs/ANALYSIS.md) over the package — fails on ANY unsuppressed
+# finding — plus ruff (pyproject.toml, rule sets E/F/B/PLE) when the
+# binary is installed.
 set -o pipefail
+
+if [[ "${1:-}" == "--analyze" ]]; then
+    python -m kafka_ps_tpu.analysis kafka_ps_tpu/ || exit 1
+    if command -v ruff >/dev/null 2>&1; then
+        ruff check . || exit 1
+    else
+        echo "ruff not installed; skipped (pscheck gate ran)"
+    fi
+    echo ANALYZE_OK
+    exit 0
+fi
 
 if [[ "${1:-}" == "--compress" ]]; then
     timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'

@@ -1,0 +1,9 @@
+"""PS100 positive fixture: a suppression with no written reason — the
+PS104 it targets is suppressed, but the bare suppression is itself an
+(unsuppressible) finding."""
+import time
+
+
+def stamp(record):
+    record.ts = time.time()  # pscheck: disable=PS104
+    return record

@@ -1,0 +1,8 @@
+"""PS104 positive fixture (scoped: lives under a log/ path): wall-clock
+read in a replay-critical module."""
+import time
+
+
+def stamp_record(record):
+    record.ts = time.time()
+    return record

@@ -1,0 +1,11 @@
+"""PS104 negative fixture: monotonic pacing and sorted set iteration
+are replay-safe."""
+import time
+
+
+def fsync_due(last, interval):
+    return time.monotonic() - last >= interval
+
+
+def release_order(worker_ids):
+    return [w for w in sorted(set(worker_ids))]

@@ -1,0 +1,8 @@
+"""PS101 positive fixture: jit built inside a plain function — neither
+module-level, nor under a cache decorator, nor returned to a caller."""
+import jax
+
+
+def handler(x):
+    fn = jax.jit(lambda v: v * 2)
+    return fn(x)

@@ -1,0 +1,31 @@
+"""PS101 negative fixture: every sanctioned jit construction site."""
+import functools
+
+import jax
+
+double = jax.jit(lambda v: v * 2)           # module level
+
+
+@functools.lru_cache(maxsize=None)
+def cached_builder(n):
+    return jax.jit(lambda v: v * n)         # keyed-cache site
+
+
+def factory(scale):
+    fn = jax.jit(lambda v: v * scale)       # factory: caller owns caching
+    return fn
+
+
+def factory_direct(scale):
+    return jax.jit(lambda v: v * scale)     # factory, direct return
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def outer(v, k):
+    inner = jax.jit(lambda u: u + k)        # inside a traced context
+    return inner(v)
+
+
+class Engine:
+    def __init__(self):
+        self._predict = jax.jit(lambda v: v)  # instance cache site

@@ -1,0 +1,16 @@
+"""PS103 negative fixture (scoped: basename net.py): frame encoders
+that are NOT tensor codecs, str.encode on a literal, and verbatim
+pass-through of already-encoded parts."""
+
+
+def encode_prediction(label):
+    return bytes([label])
+
+
+def send(sock, label):
+    header = "topic".encode()             # literal receiver: not a codec
+    sock.sendall(header + encode_prediction(label))
+
+
+def to_bytes(message):
+    return message.encoded.parts          # verbatim pass-through

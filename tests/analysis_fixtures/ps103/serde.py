@@ -1,0 +1,8 @@
+"""PS103 positive fixture (scoped: basename serde.py): re-encoding a
+message on the wire path instead of passing enc.parts through."""
+
+
+def to_bytes(codec, message):
+    if message.encoded is not None:
+        return codec.encode(message.values)   # re-encode: not idempotent
+    return bytes(message.values)

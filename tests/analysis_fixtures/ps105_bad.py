@@ -1,0 +1,10 @@
+"""PS105 positive fixture: socket write inside a lock's critical
+section."""
+import threading
+
+_lock = threading.Lock()
+
+
+def flush(sock, payload):
+    with _lock:
+        sock.sendall(payload)

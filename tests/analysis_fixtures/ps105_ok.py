@@ -1,0 +1,12 @@
+"""PS105 negative fixture: the lock covers only state mutation; the
+blocking write happens outside the critical section."""
+import threading
+
+_lock = threading.Lock()
+_pending = []
+
+
+def flush(sock, payload):
+    with _lock:
+        _pending.append(len(payload))
+    sock.sendall(payload)

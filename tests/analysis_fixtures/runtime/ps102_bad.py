@@ -1,0 +1,8 @@
+"""PS102 positive fixture (scoped: lives under a runtime/ path): one
+host sync inside a per-message handler."""
+import numpy as np
+
+
+class Node:
+    def process(self, msg):
+        return np.asarray(msg.values)

@@ -1,0 +1,14 @@
+"""PS102 negative fixture: syncs outside handlers are fine, handlers
+that stay device-resident are fine."""
+import numpy as np
+
+
+def load_rows(path):
+    # not a per-message handler — host materialization is expected here
+    return np.asarray([[1.0], [2.0]])
+
+
+class Node:
+    def process(self, msg):
+        self.theta = msg.values             # device array stays device
+        return self.theta

@@ -16,6 +16,11 @@ import jax  # noqa: E402
 # accelerator at interpreter start; pin CPU before any backend init.
 jax.config.update("jax_platforms", "cpu")
 
+# Lock-order detector: records every OrderedLock acquisition across the
+# whole session and fails it on acquisition-order cycles (potential
+# deadlocks).  Disable for one run with LOCKGRAPH=0.
+pytest_plugins = ("kafka_ps_tpu.analysis.pytest_plugin",)
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -1,8 +1,6 @@
 """CLI flag parity, CSV log sinks, checkpoint/resume, synthetic data,
 and the multi-round fused step."""
 
-import json
-import subprocess
 import sys
 
 import numpy as np
@@ -379,8 +377,8 @@ def test_threaded_run_emits_status_lines(capsys):
     app, logs, _ = build_app(0)
     app.run_threaded(max_server_iterations=40, status_every=0.05)
     err = capsys.readouterr().err
-    status_lines = [l for l in err.splitlines()
-                    if l.startswith("[status]")]
+    status_lines = [ln for ln in err.splitlines()
+                    if ln.startswith("[status]")]
     assert status_lines, err
     assert "clocks=" in status_lines[-1]
     assert "buffers=" in status_lines[-1]

@@ -236,7 +236,7 @@ def test_split_worker_sigkill_restart_recovers_buffers(tmp_path):
     # process appended fresh log rows, then shut the job down orderly
     deadline = time.monotonic() + 180.0
     def readmitted():
-        return any("readmitted worker" in l for l in server_lines)
+        return any("readmitted worker" in ln for ln in server_lines)
     while ((not readmitted() or log_rows() <= pre_rows + 2)
            and time.monotonic() < deadline):
         assert server.poll() is None, "".join(server_lines)[-3000:]
@@ -265,8 +265,8 @@ def test_split_worker_sigkill_restart_recovers_buffers(tmp_path):
     assert "readmitted worker" in server_err, server_err[-2000:]
 
     # the restart restored exactly the pre-crash window
-    restored = [l for l in wa2_err.splitlines()
-                if l.startswith("restored worker buffers")]
+    restored = [ln for ln in wa2_err.splitlines()
+                if ln.startswith("restored worker buffers")]
     assert restored, wa2_err[-2000:]
     for w, (cnt, seen) in pre.items():
         assert f"{w}:{cnt} rows (seen {seen})" in restored[0]

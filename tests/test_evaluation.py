@@ -130,6 +130,21 @@ def test_ground_truth_learns_synthetic():
     assert "precision" in gt.report
 
 
+def test_ground_truth_fit_is_not_retraced_per_call():
+    """Regression (pscheck PS101): train_offline used to build a fresh
+    `@jax.jit def fit` closure per call, re-tracing and re-compiling the
+    whole scan on every oracle evaluation.  The module-level `_fit` must
+    trace once per (shape, cfg, steps) and be reused after."""
+    cfg = ModelConfig(num_features=8, num_classes=3)
+    x, y = generate(64, cfg.num_features, cfg.num_classes, seed=0)
+    ground_truth.train_offline(x, y, cfg, steps=3)
+    before = ground_truth._fit_traces
+    theta1 = ground_truth.train_offline(x, y, cfg, steps=3)
+    theta2 = ground_truth.train_offline(x, y, cfg, steps=3)
+    assert ground_truth._fit_traces == before   # cache hit, no retrace
+    np.testing.assert_array_equal(theta1, theta2)
+
+
 def test_evaluation_cli_summarize(tmp_path):
     sp = tmp_path / "s.csv"
     _write_server_log(sp)

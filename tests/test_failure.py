@@ -4,7 +4,6 @@ runtime with fault injection (the reference delegates all of this to
 Kafka consumer-group rebalancing + k8s restarts, SURVEY §5)."""
 
 import threading
-import time
 
 import numpy as np
 import pytest
@@ -13,8 +12,7 @@ from kafka_ps_tpu.data.synth import generate
 from kafka_ps_tpu.parallel.tracker import MessageTracker
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime.app import StreamingPSApp
-from kafka_ps_tpu.utils.config import (BufferConfig, EVENTUAL, ModelConfig,
-                                       PSConfig)
+from kafka_ps_tpu.utils.config import BufferConfig, ModelConfig, PSConfig
 
 CFG_KW = dict(
     model=ModelConfig(num_features=16, num_classes=3),

@@ -2,7 +2,6 @@
 closed-form numpy, k-step local-update semantics, metric parity with sklearn
 definitions (support-weighted F1, accuracy)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

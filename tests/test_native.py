@@ -87,7 +87,7 @@ def test_auto_falls_back_on_strict_native_failure(tmp_path):
     path = tmp_path / "loose.csv"
     path.write_text("h1,h2\n1.0,2\n   \n0.5,1\n")
     rows = list(stream.iter_csv_rows(str(path)))          # auto
-    assert [l for _, l in rows] == [2, 1]
+    assert [lab for _, lab in rows] == [2, 1]
     with pytest.raises(RuntimeError, match="native parse failed"):
         list(stream.iter_csv_rows(str(path), use_native=True))
 

@@ -8,7 +8,7 @@ import pytest
 
 from kafka_ps_tpu.parallel import bsp, mesh as mesh_mod
 from kafka_ps_tpu.runtime.app import StreamingPSApp
-from kafka_ps_tpu.utils.config import BufferConfig, ModelConfig, PSConfig
+from kafka_ps_tpu.utils.config import ModelConfig
 
 from tests.test_runtime import build_app, fill_buffers, make_dataset, small_cfg
 

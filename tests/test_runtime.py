@@ -59,8 +59,10 @@ def test_serial_loop_runs_and_learns(consistency):
     # all workers participated
     assert all(w.iterations > 0 for w in app.workers)
     # server log schema: 6 fields
-    assert logs["server"] and all(len(l.split(";")) == 6 for l in logs["server"])
-    assert logs["worker"] and all(len(l.split(";")) == 7 for l in logs["worker"])
+    assert logs["server"] and all(len(ln.split(";")) == 6
+                                  for ln in logs["server"])
+    assert logs["worker"] and all(len(ln.split(";")) == 7
+                                  for ln in logs["worker"])
 
 
 def test_sequential_lockstep_clocks():

@@ -5,7 +5,7 @@ runtime-produced logs."""
 import pandas as pd
 import pytest
 
-from kafka_ps_tpu.evaluation import logs, validate
+from kafka_ps_tpu.evaluation import validate
 from kafka_ps_tpu.utils.config import EVENTUAL
 
 
