@@ -423,6 +423,93 @@ def compression_ab(iters: int = 60, warm: int = 5) -> dict:
     return out
 
 
+def slab_ab(iters: int = 30, warm: int = 5) -> dict:
+    """Incremental device-slab A/B (compress/slab.py,
+    docs/PERFORMANCE.md): one message-driven worker at the reference
+    slab shape (1024x1024), ONE row arriving between iterations —
+    the streaming regime the incremental scatter exists for — across
+    {full re-upload, incremental} x {f32, bf16, int8}.
+
+    Auditable claims: host->device bytes per update (the SlabStore
+    counter, not an estimate) drop >= 100x under the incremental path
+    (the whole-slab arm ships cap*F*4 ~ 4 MB per arrival; the scatter
+    ships one padded bucket of rows), and the resident-slab HBM bytes
+    the solver re-reads per step halve/quarter under bf16/int8.
+    updates/s rides along — on CPU or a fast interconnect the upload
+    is cheap; the bytes are what a tunneled TPU transport pays for."""
+    from kafka_ps_tpu.data.buffer import SlidingBuffer
+    from kafka_ps_tpu.data.synth import generate_hard
+    from kafka_ps_tpu.runtime import fabric as fabric_mod
+    from kafka_ps_tpu.runtime.messages import KeyRange, WeightsMessage
+    from kafka_ps_tpu.runtime.worker import WorkerNode
+    from kafka_ps_tpu.utils.config import BufferConfig, ModelConfig, PSConfig
+    from kafka_ps_tpu.utils.csvlog import NullLogSink
+
+    cap = 1024
+    model = ModelConfig()            # 1024 features — reference shape
+    x, y = generate_hard(cap + iters + warm + 8, seed=9)
+
+    def run_arm(dtype: str, incremental: bool) -> dict:
+        cfg = PSConfig(num_workers=1, model=model, use_gang=False,
+                       buffer=BufferConfig(max_size=cap),
+                       eval_every=10 ** 9, slab_dtype=dtype,
+                       slab_incremental=incremental)
+        buf = SlidingBuffer(model.num_features, cfg.buffer)
+        for i in range(cap):         # burst prefill: target clamps to cap
+            buf.add(dict(enumerate(x[i])), int(y[i]))
+        fab = fabric_mod.Fabric()
+        node = WorkerNode(0, cfg, fab, buf, log=NullLogSink())
+        theta = np.zeros((node.task.num_params,), np.float32)
+        store = node._slab_store
+
+        def step(clock: int) -> None:
+            # the per-arrival cadence: one new row, one weights message
+            i = cap + clock
+            buf.add(dict(enumerate(x[i])), int(y[i]))
+            node.on_weights(WeightsMessage(
+                vector_clock=clock,
+                key_range=KeyRange(0, node.task.num_params),
+                values=theta))
+
+        for c in range(warm):        # compile upload/scatter + solver
+            step(c)
+        bytes0 = store.bytes_uploaded
+        t0 = time.perf_counter()
+        for c in range(warm, warm + iters):
+            step(c)
+        g = None
+        for _ in range(warm + iters):
+            g = fab.poll(fabric_mod.GRADIENTS_TOPIC, 0) or g
+        np.asarray(g.values)         # sync the async dispatch chain
+        dt = time.perf_counter() - t0
+        return {
+            "bytes_uploaded_per_update": round(
+                (store.bytes_uploaded - bytes0) / iters),
+            "worker_updates_per_sec": round(iters / dt, 2),
+            "full_uploads": store.full_uploads,
+            "incremental_applies": store.incremental_applies,
+            "device_slab_bytes": store.device_bytes(),
+        }
+
+    arms: dict = {}
+    for dtype in ("f32", "bf16", "int8"):
+        arms[f"{dtype}_full"] = run_arm(dtype, incremental=False)
+        arms[f"{dtype}_incremental"] = run_arm(dtype, incremental=True)
+    out: dict = {"iters": iters, "buffer_cap": cap,
+                 "num_features": model.num_features, "arms": arms}
+    for dtype in ("f32", "bf16", "int8"):
+        out[f"{dtype}_bytes_ratio_full_over_incremental"] = round(
+            arms[f"{dtype}_full"]["bytes_uploaded_per_update"]
+            / max(arms[f"{dtype}_incremental"]["bytes_uploaded_per_update"],
+                  1), 1)
+    f32_hbm = arms["f32_incremental"]["device_slab_bytes"]
+    for dtype in ("bf16", "int8"):
+        out[f"{dtype}_device_bytes_ratio_vs_f32"] = round(
+            f32_hbm / max(arms[f"{dtype}_incremental"]["device_slab_bytes"],
+                          1), 2)
+    return out
+
+
 def runtime_mlp4096(trials: int) -> tuple[dict, float]:
     """MLP-4096 through the FULL PS runtime — the loop `cli/run.py
     --fused --task mlp --hidden_dim 4096` drives (StreamingPSApp
@@ -719,6 +806,23 @@ def main() -> None:
     # -- compressed delta transport A/B (docs/COMPRESSION.md) --------------
     compression = compression_ab()
 
+    # -- incremental device slab A/B (docs/PERFORMANCE.md) -----------------
+    slab = slab_ab()
+    # slab-dtype-scaled roofline: same FLOPs, stored-bytes slab traffic —
+    # arithmetic intensity rises as --slab-dtype shrinks what each
+    # matmul streams from HBM (the bf16/int8 half of the memory wall)
+    slab_roofs = []
+    for sd, xbytes in (("f32", 4.0), ("bf16", 2.0), ("int8", 1.0)):
+        ups = slab["arms"][f"{sd}_incremental"]["worker_updates_per_sec"]
+        roof = with_measured(roofline(
+            logreg_update_flops(buffer_cap, cfg.num_features, c1,
+                                cfg.num_max_iter),
+            logreg_update_bytes(buffer_cap, cfg.num_features,
+                                cfg.num_max_iter) * xbytes / 4.0,
+            ups, dev))
+        slab_roofs.append({"slab_dtype": sd,
+                           "worker_updates_per_sec": ups, **roof})
+
     baseline = 1.85   # best aggregate worker-updates/s in reference logs
     payload = {
         "metric": "worker_updates_per_sec",
@@ -747,11 +851,13 @@ def main() -> None:
                 "gang_ab": gang_ab,
                 "serving_ab": serving,
                 "compression_ab": compression,
+                "slab_ab": slab,
             },
             "roofline": {
                 "device_kind": getattr(dev, "device_kind", "unknown"),
                 **calib,
                 "logreg_fused": logreg_roof,
+                "logreg_slab_dtype_scaled": slab_roofs,
                 "mlp_hidden_sweep": hidden_sweep,
             },
         },
@@ -798,9 +904,25 @@ def main() -> None:
             "compress_int8_acc_delta": compression["int8_acc_delta_max"],
             "compress_topk_wire_ratio": compression[
                 "topk_01_wire_ratio_min"],
+            "slab_bytes_ratio_f32": slab[
+                "f32_bytes_ratio_full_over_incremental"],
+            "slab_int8_hbm_ratio": slab["int8_device_bytes_ratio_vs_f32"],
         },
         "detail_file": "bench_out.json",
     })
+    # Self-check the whole capture contract before emitting anything:
+    # the file on disk must re-parse (a torn write shows up HERE, not in
+    # the next harness run), the summary must itself be valid JSON, and
+    # it must be one line short enough that a tail-truncating log
+    # capture (the observed BENCH parsed:null failure kept only the
+    # last ~2000 chars of stdout) can never cut it mid-object.
+    with open("bench_out.json") as fh:
+        reread = json.load(fh)
+    assert reread["metric"] == payload["metric"], "bench_out.json torn"
+    json.loads(summary_line)
+    assert "\n" not in summary_line, "summary must be a single line"
+    assert len(summary_line) < 1900, (
+        f"summary line {len(summary_line)} chars risks tail truncation")
     # Output contract (harness BENCH parse): the compact JSON summary is
     # the STRICTLY-LAST stdout line.  Flush everything buffered first so
     # no library write interleaves after it, then emit the line and

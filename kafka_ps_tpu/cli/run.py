@@ -113,6 +113,23 @@ def build_parser(include_server_flags: bool = True,
                         "In socket mode both processes must name the "
                         "same codec (negotiated on HELLO; mismatches "
                         "fall back to none).  Incompatible with --fused")
+    p.add_argument("--slab-dtype", dest="slab_dtype",
+                   choices=["f32", "bf16", "int8"], default="f32",
+                   help="storage precision of each worker's "
+                        "device-resident training slab (compress/slab.py, "
+                        "docs/PERFORMANCE.md): bf16 halves and int8 "
+                        "(per-row max-abs scales) quarters the bytes the "
+                        "training step streams from HBM; decode is fused "
+                        "into the solver.  f32 is bitwise-identical to a "
+                        "build without the flag.  Incompatible with "
+                        "--fused (its BSP step keeps its own slab cache)")
+    p.add_argument("--full-slab-upload", action="store_true",
+                   dest="full_slab_upload",
+                   help="disable incremental device-slab updates: "
+                        "re-upload the whole slab whenever the buffer "
+                        "changes instead of scattering only dirty rows "
+                        "(the pre-PERFORMANCE.md behavior; the A/B lever "
+                        "behind the slab_ab bench block)")
     p.add_argument("--no-gang", action="store_true", dest="no_gang",
                    help="disable gang-scheduled dispatch: process every "
                         "gate release as its own device step instead of "
@@ -211,6 +228,8 @@ def make_app_from_args(args, resuming: bool = False,
         eval_every=getattr(args, "eval_every", 1),
         use_gang=not getattr(args, "no_gang", False),
         compress=getattr(args, "compress", "none") or "none",
+        slab_dtype=getattr(args, "slab_dtype", "f32") or "f32",
+        slab_incremental=not getattr(args, "full_slab_upload", False),
         serving=ServingConfig(
             enabled=getattr(args, "serve", False),
             port=getattr(args, "serve_port", None),
@@ -286,6 +305,14 @@ def run_with_args(args) -> int:
     if getattr(args, "serve_port", None) is not None \
             and not getattr(args, "serve", False):
         raise SystemExit("--serve_port requires --serve")
+    if getattr(args, "slab_dtype", "f32") != "f32" and args.fused:
+        # the fused BSP step (runtime/app.run_fused_bsp) keeps its own
+        # whole-slab device cache outside the worker SlabStore path —
+        # silently ignoring the dtype would misreport what ran
+        raise SystemExit(
+            "--slab-dtype applies to the per-node worker slab "
+            "(compress/slab.py); the --fused BSP path keeps its own "
+            "slab cache — drop one of the two flags")
     compress = getattr(args, "compress", "none") or "none"
     if compress != "none":
         from kafka_ps_tpu.compress.wire import parse_codec

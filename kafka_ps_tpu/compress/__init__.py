@@ -10,13 +10,17 @@ from kafka_ps_tpu.compress.codecs import (Codec, WeightsCompressor,
                                           decode_message_parts, get_codec,
                                           make_compressor)
 from kafka_ps_tpu.compress.feedback import ErrorFeedback
+from kafka_ps_tpu.compress.slab import (SLAB_DTYPES, QuantizedSlab,
+                                        SlabStore, decode_x,
+                                        dequantize_rows, quantize_rows)
 from kafka_ps_tpu.compress.wire import (CODEC_BF16, CODEC_INT8, CODEC_NONE,
                                         CODEC_TOPK, INT8_CHUNK, NONE,
                                         CodecSpec, parse_codec)
 
 __all__ = [
-    "Codec", "CodecSpec", "ErrorFeedback", "WeightsCompressor",
+    "Codec", "CodecSpec", "ErrorFeedback", "QuantizedSlab", "SlabStore",
+    "SLAB_DTYPES", "WeightsCompressor",
     "CODEC_NONE", "CODEC_BF16", "CODEC_INT8", "CODEC_TOPK", "INT8_CHUNK",
-    "NONE", "decode_message_parts", "get_codec", "make_compressor",
-    "parse_codec",
+    "NONE", "decode_message_parts", "decode_x", "dequantize_rows",
+    "get_codec", "make_compressor", "parse_codec", "quantize_rows",
 ]

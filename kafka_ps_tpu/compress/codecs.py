@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from kafka_ps_tpu.compress import wire
+from kafka_ps_tpu.compress.slab import dequantize_rows, quantize_rows
 from kafka_ps_tpu.compress.wire import (CODEC_BF16, CODEC_INT8, CODEC_NONE,
                                         CODEC_TOPK, INT8_CHUNK, CodecSpec)
 from kafka_ps_tpu.runtime.messages import EncodedValues
@@ -45,19 +46,21 @@ def _build_fns(spec: CodecSpec, n: int):
         return encode, decode
 
     if spec.codec_id == CODEC_INT8:
+        # per-chunk max-abs quantization via the shared device-side
+        # primitive (compress/slab.quantize_rows — same ops, so this
+        # refactor is bitwise-invisible to the EF/replay contract); the
+        # wire codec's "row" is a 256-value chunk of the flat vector,
+        # the slab codec's is a feature row
         nchunks = wire.int8_chunks(n)
         pad = nchunks * INT8_CHUNK - n
 
         def encode(v):
             r = jnp.pad(v, (0, pad)).reshape(nchunks, INT8_CHUNK)
-            scale = jnp.max(jnp.abs(r), axis=1) / 127.0
-            safe = jnp.where(scale > 0, scale, 1.0)
-            q = jnp.clip(jnp.round(r / safe[:, None]), -127, 127)
-            return q.astype(jnp.int8).reshape(-1), scale
+            q, scale = quantize_rows(r)
+            return q.reshape(-1), scale
 
         def decode(q, scale):
-            r = (q.reshape(nchunks, INT8_CHUNK).astype(jnp.float32)
-                 * scale[:, None])
+            r = dequantize_rows(q.reshape(nchunks, INT8_CHUNK), scale)
             return r.reshape(-1)[:n]
         return encode, decode
 

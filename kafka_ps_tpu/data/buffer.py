@@ -59,6 +59,15 @@ class SlidingBuffer:
         self._clock_ms = clock_ms or _default_clock_ms
         self._inter_arrival_ms: deque[float] = deque(maxlen=cfg.arrival_window)
         self._last_arrival_ms: float | None = None
+        # Slots whose (x, y, insertion_id) changed since the last
+        # drain/clearing snapshot — the incremental device-slab path
+        # (compress/slab.SlabStore.apply_rows) uploads only these.
+        self._dirty: set[int] = set()
+        # Monotonic mutation counter.  num_tuples_seen is NOT a valid
+        # change detector (restore_state can rewind it; a mass-delete
+        # with one insert moves it by 1 while touching many slots), so
+        # the worker keys its device-slab cache off this instead.
+        self._version = 0
         # add() and snapshot() are internally synchronized so the producer
         # thread and the training loop need no external locking.
         self._lock = OrderedLock("SlidingBuffer.state")
@@ -120,6 +129,7 @@ class SlidingBuffer:
             n = count - target
             oldest_first = filled[np.argsort(self.insertion_id[filled])]
             self.insertion_id[oldest_first[:n]] = 0
+            self._dirty.update(int(s) for s in oldest_first[:n])
             slot = int(oldest_first[n])
 
         if isinstance(features, dict):
@@ -129,6 +139,8 @@ class SlidingBuffer:
         self.x[slot] = row
         self.y[slot] = label
         self.insertion_id[slot] = new_id
+        self._dirty.add(slot)
+        self._version += 1
 
     # -- views for the training step ---------------------------------------
 
@@ -144,11 +156,45 @@ class SlidingBuffer:
         with self._lock:
             return int(self.insertion_id.max())
 
-    def snapshot(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    @property
+    def version(self) -> int:
+        """Monotonic mutation counter (bumps on every add/restore).
+        Compare against a cached value to detect staleness — unlike
+        num_tuples_seen this never aliases across restore_state."""
+        with self._lock:
+            return self._version
+
+    @property
+    def dirty_slots(self) -> list[int]:
+        """Sorted slots touched since the last drain (non-clearing view,
+        for tests/inspection; drain_dirty is the consuming call)."""
+        with self._lock:
+            return sorted(self._dirty)
+
+    def drain_dirty(self):
+        """(slots, x_rows, y_rows, mask_rows) for every slot touched
+        since the last drain, then forget them — the delta the
+        incremental device-slab path scatters instead of re-uploading
+        the whole slab.  One lock acquisition, so the rows are a
+        consistent cut: a slot deleted by a target shrink comes back
+        with mask 0 and whatever stale x/y it holds (the mask is what
+        the solver trusts, exactly as in snapshot())."""
+        with self._lock:
+            slots = np.asarray(sorted(self._dirty), dtype=np.int64)
+            self._dirty.clear()
+            mask = (self.insertion_id[slots] > 0).astype(np.float32)
+            return slots, self.x[slots].copy(), self.y[slots].copy(), mask
+
+    def snapshot(self, clear_dirty: bool = False
+                 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(x, y, mask) — a consistent copy of the static-shape slab
-        shipped to the device; safe to use without holding any lock."""
+        shipped to the device; safe to use without holding any lock.
+        clear_dirty=True marks the copy as the new device baseline
+        (a full upload subsumes any pending incremental delta)."""
         with self._lock:
             mask = (self.insertion_id > 0).astype(np.float32)
+            if clear_dirty:
+                self._dirty.clear()
             return self.x.copy(), self.y.copy(), mask
 
     # -- durability (utils/checkpoint.py) ----------------------------------
@@ -181,3 +227,6 @@ class SlidingBuffer:
             self._inter_arrival_ms.clear()
             self._inter_arrival_ms.extend(float(v) for v in st["arrivals"])
             self._last_arrival_ms = None
+            # every slot may differ from what a device slab holds
+            self._dirty.update(range(self.x.shape[0]))
+            self._version += 1

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kafka_ps_tpu.compress.slab import decode_x
 from kafka_ps_tpu.utils.config import ModelConfig
 
 
@@ -122,7 +123,12 @@ def local_update(theta: jax.Array, x: jax.Array, y: jax.Array, mask: jax.Array,
     so the whole thing is one fused XLA program; the capability
     ("k local solver steps, delta exchanged") is what is matched, not
     Spark's line-search trajectory (documented divergence, SURVEY §7).
+
+    `x` may arrive in any device-slab storage form (f32/bf16 array or
+    QuantizedSlab) — decode fuses into this program, and for f32 it is
+    the identity, leaving the jaxpr bitwise-unchanged.
     """
+    x = decode_x(x)
     onehot = jax.nn.one_hot(y, cfg.num_rows, dtype=jnp.float32)
     return local_update_onehot(theta, x, onehot, mask, cfg=cfg)
 

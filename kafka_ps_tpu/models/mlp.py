@@ -24,6 +24,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from kafka_ps_tpu.compress.slab import decode_x
 from kafka_ps_tpu.models import metrics as metrics_mod
 from kafka_ps_tpu.utils.config import ModelConfig
 
@@ -100,6 +101,8 @@ class MLPTask:
         return _local_update_onehot(theta, x, onehot, mask, cfg=self.cfg)
 
     def local_update(self, theta, x, y, mask):
+        # slab-storage decode (f32 identity) fuses into the jit below
+        x = decode_x(x)
         onehot = jax.nn.one_hot(y, self.cfg.num_rows, dtype=jnp.float32)
         return self.local_update_onehot(theta, x, onehot, mask)
 

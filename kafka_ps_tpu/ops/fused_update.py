@@ -55,11 +55,32 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from kafka_ps_tpu.compress.slab import QuantizedSlab
 from kafka_ps_tpu.models import logreg
 from kafka_ps_tpu.utils.config import ModelConfig
 
 LANES = 128          # last-dim tile width; class axis padded up to this
 _VMEM_BYTE_BUDGET = 12 * 1024 * 1024   # leave headroom below ~16 MB/core
+
+
+def _slab_kind(x) -> str:
+    """Storage form of a device slab (compress/slab.py): "f32", "bf16"
+    or "int8" (QuantizedSlab).  Decided at trace time — one compiled
+    program per storage form."""
+    if isinstance(x, QuantizedSlab):
+        return "int8"
+    if x.dtype == jnp.bfloat16:
+        return "bf16"
+    return "f32"
+
+
+_X_BYTES = {"f32": 4, "bf16": 2, "int8": 1}
+
+
+def _slab_shape(x) -> tuple[int, int]:
+    """(batch, num_features) of the trailing slab dims, any storage."""
+    a = x.q if isinstance(x, QuantizedSlab) else x
+    return a.shape[-2], a.shape[-1]
 
 
 def _kernel(x_ref, y_ref, mask_ref, w0_ref, b0_ref,
@@ -136,17 +157,32 @@ def local_update(theta: jax.Array, x: jax.Array, y: jax.Array,
     steps on the buffer → (delta, loss at the updated parameters).
 
     `interpret=True` runs the kernel in the Pallas interpreter (CPU
-    correctness tests); on non-TPU backends without interpret, or when
-    the batch exceeds the VMEM budget, falls back to the XLA path.
+    correctness tests).  Dispatch (docs/PERFORMANCE.md): an f32 slab
+    that fits whole in VMEM takes this resident kernel (bitwise
+    unchanged from before the slab-dtype feature); anything else that
+    a streaming tile fits — oversize f32 slabs, bf16/int8 slab
+    storage — takes the tiled double-buffered kernel below
+    (`_stream_update`); only when even one tile plus the weight set
+    exceeds the budget, or off-TPU without interpret, does it fall
+    back to the XLA path (which decodes slab storage itself).
     """
-    batch, num_features = x.shape
+    kind = _slab_kind(x)
+    batch, num_features = _slab_shape(x)
     on_tpu = jax.default_backend() == "tpu"
-    if not (fits_in_vmem(batch, num_features) and (on_tpu or interpret)):
+    can_run = on_tpu or interpret
+    tile = stream_tile(batch, num_features, kind)
+    if not (can_run and (kind == "f32" and fits_in_vmem(batch,
+                                                        num_features)
+                         or tile is not None)):
         if not allow_fallback:
             raise ValueError(
                 f"pallas local_update unavailable (batch={batch}, "
-                f"features={num_features}, backend={jax.default_backend()})")
+                f"features={num_features}, slab={kind}, "
+                f"backend={jax.default_backend()})")
         return logreg.local_update(theta, x, y, mask, cfg=cfg)
+    if not (kind == "f32" and fits_in_vmem(batch, num_features)):
+        return _stream_update(theta, x, y, mask, cfg=cfg, tile=tile,
+                              interpret=interpret)
 
     params = logreg.unflatten(theta, cfg)
     w0 = jnp.zeros((LANES, num_features), jnp.float32
@@ -281,20 +317,29 @@ def mlp_local_update(theta: jax.Array, x: jax.Array, y: jax.Array,
                      ) -> tuple[jax.Array, jax.Array]:
     """Drop-in replacement for MLPTask.local_update (models/mlp.py):
     k full-batch GD steps on the buffer → (delta, loss at the updated
-    parameters).  Fallback rules match `local_update`."""
+    parameters).  Dispatch rules match `local_update`: resident kernel
+    for whole-VMEM f32 slabs, streaming kernel for oversize or
+    reduced-precision slabs, XLA fallback last."""
     from kafka_ps_tpu.models import mlp as mlp_mod
 
-    batch, num_features = x.shape
+    kind = _slab_kind(x)
+    batch, num_features = _slab_shape(x)
     hidden = cfg.hidden_dim
     on_tpu = jax.default_backend() == "tpu"
-    if not (mlp_fits_in_vmem(batch, num_features, hidden)
-            and (on_tpu or interpret)):
+    can_run = on_tpu or interpret
+    resident = (kind == "f32"
+                and mlp_fits_in_vmem(batch, num_features, hidden))
+    tile = mlp_stream_tile(batch, num_features, hidden, kind)
+    if not (can_run and (resident or tile is not None)):
         if not allow_fallback:
             raise ValueError(
                 f"pallas mlp_local_update unavailable (batch={batch}, "
                 f"features={num_features}, hidden={hidden}, "
-                f"backend={jax.default_backend()})")
+                f"slab={kind}, backend={jax.default_backend()})")
         return mlp_mod.MLPTask(cfg).local_update(theta, x, y, mask)
+    if not resident:
+        return _mlp_stream_update(theta, x, y, mask, cfg=cfg, tile=tile,
+                                  interpret=interpret)
 
     params = mlp_mod.unflatten(theta, cfg)
     h8 = hidden + (-hidden) % LANES
@@ -331,6 +376,440 @@ def mlp_local_update(theta: jax.Array, x: jax.Array, y: jax.Array,
       y.astype(jnp.int32).reshape(-1, 1),
       mask.astype(jnp.float32).reshape(-1, 1),
       w1, b1, w2, b2)
+
+    delta = mlp_mod.flatten(mlp_mod.MLPParams(
+        w1=dw1[:hidden], b1=db1[0, :hidden],
+        w2=dw2[:cfg.num_rows, :hidden], b2=db2[0, :cfg.num_rows]))
+    return delta, loss[0, 0]
+
+
+# -- streaming kernels: tiled, double-buffered VMEM (docs/PERFORMANCE.md) ----
+# Slabs too large to sit whole in VMEM — and every reduced-precision
+# slab (bf16/int8 storage, compress/slab.py) — stream through on-chip
+# memory instead of falling back to XLA.  The grid is
+# (k_solver_steps + 1, batch_tiles): the LAST axis iterates fastest, so
+# each solver step walks every batch tile before the step index
+# advances, and Pallas double-buffers the blocked x/y/mask specs (the
+# next tile's DMA overlaps this tile's compute).  Weights live in VMEM
+# scratch for the WHOLE call — per solver step the per-tile gradient
+# contributions accumulate into scratch and apply once at the step's
+# final tile; grid step (k, t) is the loss pass over the updated
+# weights; outputs are written only at the very last grid step (the
+# revisited-output accumulator pattern).  Reduced-precision decode
+# happens per tile, in-kernel, right after the DMA — so the bytes that
+# cross HBM->VMEM are the *stored* bytes (2 or ~1 per element), which
+# is the whole point of --slab-dtype.
+#
+# Tile rows are multiples of 32 (the int8 min sublane tile; also
+# satisfies bf16's 16 and f32's 8) and the feature axis must be a lane
+# multiple; the chooser picks the largest tile whose working set fits
+# the budget.  When even the weight set + one minimal tile can't fit,
+# streaming is impossible and the caller falls back to XLA (or raises
+# under allow_fallback=False).
+
+_STREAM_TILES = (512, 256, 128, 64, 32)
+
+
+def _stream_bytes(tile: int, num_features: int, kind: str) -> int:
+    """Streaming working set: the resident weight set (w0 + carry +
+    grad accumulator + dw output), double-buffered x/y/mask tiles in
+    their STORED dtype (+ the int8 per-row scales), and the [tile,
+    LANES] class activations."""
+    weight_set = 4 * LANES * num_features * 4
+    x_tile = num_features * _X_BYTES[kind] + (4 if kind == "int8" else 0)
+    return (weight_set + 2 * tile * x_tile + 2 * tile * 8
+            + 3 * tile * LANES * 4)
+
+
+def stream_tile(batch: int, num_features: int, kind: str) -> int | None:
+    """Largest usable batch-tile height, or None if streaming can't fit
+    (weight set alone blows the budget) or the feature axis isn't a
+    lane multiple (Mosaic tiling constraint)."""
+    if num_features % LANES:
+        return None
+    bp = batch + (-batch) % 32
+    for t in _STREAM_TILES:
+        if (t <= max(bp, 32)
+                and _stream_bytes(t, num_features, kind)
+                <= _VMEM_BYTE_BUDGET):
+            return t
+    return None
+
+
+def _pad_rows(x, y, mask, multiple: int):
+    """Pad the batch axis to a tile multiple — padded rows carry mask 0
+    (and, for QuantizedSlab, zero rows/scales), so they contribute
+    nothing; handles every slab storage form."""
+    batch = _slab_shape(x)[0]
+    pad_b = (-batch) % multiple
+    if not pad_b:
+        return x, y, mask
+    if isinstance(x, QuantizedSlab):
+        x = QuantizedSlab(q=jnp.pad(x.q, ((0, pad_b), (0, 0))),
+                          scale=jnp.pad(x.scale, ((0, pad_b), (0, 0))))
+    else:
+        x = jnp.pad(x, ((0, pad_b), (0, 0)))
+    return x, jnp.pad(y, ((0, pad_b),)), jnp.pad(mask, ((0, pad_b),))
+
+
+def _stream_core(x, y, mask, w0_ref, b0_ref, denom_ref,
+                 dw_ref, db_ref, loss_ref,
+                 w_scr, b_scr, gw_scr, gb_scr, loss_scr,
+                 *, k: int, lr: float, num_rows: int, ntiles: int):
+    """Grid-step body shared by the f32/bf16 and int8 wrappers; `x` is
+    the already-decoded f32 tile."""
+    s = pl.program_id(0)        # solver step; s == k is the loss pass
+    t = pl.program_id(1)        # batch tile
+    tile = x.shape[0]
+
+    @pl.when(jnp.logical_and(s == 0, t == 0))
+    def _init():
+        w_scr[:] = w0_ref[:]
+        b_scr[:] = b0_ref[:]
+
+    @pl.when(t == 0)
+    def _zero():
+        gw_scr[:] = jnp.zeros(gw_scr.shape, jnp.float32)
+        gb_scr[:] = jnp.zeros(gb_scr.shape, jnp.float32)
+        loss_scr[0, 0] = 0.0
+
+    class_ids = jax.lax.broadcasted_iota(jnp.int32, (tile, LANES), 1)
+    valid = (class_ids < num_rows).astype(jnp.float32)
+    onehot = (class_ids == y).astype(jnp.float32) * valid
+    neg_inf_pad = (1.0 - valid) * (-1e30)
+    denom = denom_ref[0, 0]
+
+    logits = jax.lax.dot_general(
+        x, w_scr[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) + b_scr[:] + neg_inf_pad
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    @pl.when(s < k)
+    def _grad():
+        g = (jnp.exp(logp) - onehot) * (mask / denom)
+        gw_scr[:] += jax.lax.dot_general(
+            g, x, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        gb_scr[:] += jnp.sum(g, axis=0, keepdims=True)
+
+    @pl.when(jnp.logical_and(s < k, t == ntiles - 1))
+    def _apply():
+        w_scr[:] = w_scr[:] - lr * gw_scr[:]
+        b_scr[:] = b_scr[:] - lr * gb_scr[:]
+
+    @pl.when(s == k)
+    def _loss():
+        nll = -jnp.sum(logp * onehot, axis=-1, keepdims=True)
+        loss_scr[0, 0] += jnp.sum(nll * mask)
+
+    @pl.when(jnp.logical_and(s == k, t == ntiles - 1))
+    def _emit():
+        dw_ref[:] = w_scr[:] - w0_ref[:]
+        db_ref[:] = b_scr[:] - b0_ref[:]
+        loss_ref[0, 0] = loss_scr[0, 0] / denom
+
+
+def _stream_kernel(x_ref, y_ref, mask_ref, w0_ref, b0_ref, denom_ref,
+                   dw_ref, db_ref, loss_ref,
+                   w_scr, b_scr, gw_scr, gb_scr, loss_scr,
+                   *, k, lr, num_rows, ntiles):
+    _stream_core(x_ref[:].astype(jnp.float32), y_ref[:], mask_ref[:],
+                 w0_ref, b0_ref, denom_ref, dw_ref, db_ref, loss_ref,
+                 w_scr, b_scr, gw_scr, gb_scr, loss_scr,
+                 k=k, lr=lr, num_rows=num_rows, ntiles=ntiles)
+
+
+def _stream_kernel_q(q_ref, scale_ref, y_ref, mask_ref, w0_ref, b0_ref,
+                     denom_ref, dw_ref, db_ref, loss_ref,
+                     w_scr, b_scr, gw_scr, gb_scr, loss_scr,
+                     *, k, lr, num_rows, ntiles):
+    # per-row scales broadcast over the lane axis — decode costs one
+    # VPU multiply per element, paid AFTER the 1-byte DMA
+    x = q_ref[:].astype(jnp.float32) * scale_ref[:]
+    _stream_core(x, y_ref[:], mask_ref[:],
+                 w0_ref, b0_ref, denom_ref, dw_ref, db_ref, loss_ref,
+                 w_scr, b_scr, gw_scr, gb_scr, loss_scr,
+                 k=k, lr=lr, num_rows=num_rows, ntiles=ntiles)
+
+
+def _stream_update(theta, x, y, mask, *, cfg: ModelConfig, tile: int,
+                   interpret: bool):
+    """Tiled logreg solver call — same contract as the resident kernel,
+    any slab storage form."""
+    num_features = _slab_shape(x)[1]
+    kind = _slab_kind(x)
+    # denom over the UNPADDED mask (padding adds zeros — equal either
+    # way; computed here once instead of per grid step)
+    denom = jnp.maximum(jnp.sum(mask.astype(jnp.float32)),
+                        1.0).reshape(1, 1)
+    x, y, mask = _pad_rows(x, y, mask, tile)
+    ntiles = _slab_shape(x)[0] // tile
+
+    params = logreg.unflatten(theta, cfg)
+    w0 = jnp.zeros((LANES, num_features), jnp.float32
+                   ).at[:cfg.num_rows].set(params.weights)
+    b0 = jnp.zeros((1, LANES), jnp.float32
+                   ).at[0, :cfg.num_rows].set(params.intercept)
+
+    def tmap(s, t):
+        return (t, 0)
+
+    def wmap(s, t):
+        return (0, 0)
+
+    def tspec(width):
+        return pl.BlockSpec((tile, width), tmap, memory_space=pltpu.VMEM)
+
+    y2 = y.astype(jnp.int32).reshape(-1, 1)
+    m2 = mask.astype(jnp.float32).reshape(-1, 1)
+    if kind == "int8":
+        body, operands = _stream_kernel_q, (x.q, x.scale, y2, m2)
+        in_specs = [tspec(num_features), tspec(1), tspec(1), tspec(1)]
+    else:
+        body, operands = _stream_kernel, (x, y2, m2)
+        in_specs = [tspec(num_features), tspec(1), tspec(1)]
+    in_specs += [
+        pl.BlockSpec((LANES, num_features), wmap, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, LANES), wmap, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1), wmap, memory_space=pltpu.SMEM),
+    ]
+
+    kernel = functools.partial(body, k=cfg.num_max_iter,
+                               lr=cfg.local_learning_rate,
+                               num_rows=cfg.num_rows, ntiles=ntiles)
+    # pscheck: disable=PS101 (traced only inside jit'd local_update, cached per (shape, dtype))
+    dw, db, loss = pl.pallas_call(
+        kernel,
+        grid=(cfg.num_max_iter + 1, ntiles),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((LANES, num_features), wmap,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, LANES), wmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), wmap, memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((LANES, num_features), jnp.float32),
+            jax.ShapeDtypeStruct((1, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((LANES, num_features), jnp.float32),
+            pltpu.VMEM((1, LANES), jnp.float32),
+            pltpu.VMEM((LANES, num_features), jnp.float32),
+            pltpu.VMEM((1, LANES), jnp.float32),
+            pltpu.SMEM((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*operands, w0, b0, denom)
+
+    delta = logreg.LogRegParams(weights=dw[:cfg.num_rows],
+                                intercept=db[0, :cfg.num_rows]).flat
+    return delta, loss[0, 0]
+
+
+def _mlp_stream_bytes(tile: int, num_features: int, h8: int,
+                      kind: str) -> int:
+    """MLP streaming working set: both weight sets resident ×4 (input,
+    carry, grad accumulator, delta output), double-buffered tiles, and
+    the per-tile hidden + class activations."""
+    w1_set = 4 * h8 * num_features * 4
+    w2_set = 4 * LANES * h8 * 4
+    x_tile = num_features * _X_BYTES[kind] + (4 if kind == "int8" else 0)
+    return (w1_set + w2_set + 2 * tile * x_tile + 2 * tile * 8
+            + 3 * tile * h8 * 4 + 3 * tile * LANES * 4)
+
+
+def mlp_stream_tile(batch: int, num_features: int, hidden: int,
+                    kind: str) -> int | None:
+    if num_features % LANES:
+        return None
+    h8 = hidden + (-hidden) % LANES
+    bp = batch + (-batch) % 32
+    for t in _STREAM_TILES:
+        if (t <= max(bp, 32)
+                and _mlp_stream_bytes(t, num_features, h8, kind)
+                <= _VMEM_BYTE_BUDGET):
+            return t
+    return None
+
+
+def _mlp_stream_core(x, y, mask,
+                     w10_ref, b10_ref, w20_ref, b20_ref, denom_ref,
+                     dw1_ref, db1_ref, dw2_ref, db2_ref, loss_ref,
+                     w1_scr, b1_scr, w2_scr, b2_scr,
+                     gw1_scr, gb1_scr, gw2_scr, gb2_scr, loss_scr,
+                     *, k: int, lr: float, num_rows: int, ntiles: int):
+    """MLP grid-step body: the _mlp_kernel math per tile, weight state
+    and gradient accumulators in scratch across the grid (same
+    row_valid factor — the XLA path it must match is jax.grad-based,
+    see the note in _mlp_kernel)."""
+    s = pl.program_id(0)
+    t = pl.program_id(1)
+    tile = x.shape[0]
+
+    @pl.when(jnp.logical_and(s == 0, t == 0))
+    def _init():
+        w1_scr[:] = w10_ref[:]
+        b1_scr[:] = b10_ref[:]
+        w2_scr[:] = w20_ref[:]
+        b2_scr[:] = b20_ref[:]
+
+    @pl.when(t == 0)
+    def _zero():
+        gw1_scr[:] = jnp.zeros(gw1_scr.shape, jnp.float32)
+        gb1_scr[:] = jnp.zeros(gb1_scr.shape, jnp.float32)
+        gw2_scr[:] = jnp.zeros(gw2_scr.shape, jnp.float32)
+        gb2_scr[:] = jnp.zeros(gb2_scr.shape, jnp.float32)
+        loss_scr[0, 0] = 0.0
+
+    class_ids = jax.lax.broadcasted_iota(jnp.int32, (tile, LANES), 1)
+    valid = (class_ids < num_rows).astype(jnp.float32)
+    onehot = (class_ids == y).astype(jnp.float32) * valid
+    neg_inf_pad = (1.0 - valid) * (-1e30)
+    row_valid = jnp.sum(onehot, axis=-1, keepdims=True)     # [T, 1]
+    denom = denom_ref[0, 0]
+
+    pre = jax.lax.dot_general(
+        x, w1_scr[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) + b1_scr[:]     # [T, H8]
+    hid = jnp.maximum(pre, 0.0)
+    logits = jax.lax.dot_general(
+        hid, w2_scr[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) + b2_scr[:] + neg_inf_pad
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    @pl.when(s < k)
+    def _grad():
+        g = (jnp.exp(logp) - onehot) * (mask * row_valid / denom)
+        gw2_scr[:] += jax.lax.dot_general(
+            g, hid, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [C8, H8]
+        gb2_scr[:] += jnp.sum(g, axis=0, keepdims=True)
+        dh = jax.lax.dot_general(
+            g, w2_scr[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [T, H8]
+        dh = dh * (pre > 0.0).astype(jnp.float32)
+        gw1_scr[:] += jax.lax.dot_general(
+            dh, x, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [H8, F]
+        gb1_scr[:] += jnp.sum(dh, axis=0, keepdims=True)
+
+    @pl.when(jnp.logical_and(s < k, t == ntiles - 1))
+    def _apply():
+        w1_scr[:] = w1_scr[:] - lr * gw1_scr[:]
+        b1_scr[:] = b1_scr[:] - lr * gb1_scr[:]
+        w2_scr[:] = w2_scr[:] - lr * gw2_scr[:]
+        b2_scr[:] = b2_scr[:] - lr * gb2_scr[:]
+
+    @pl.when(s == k)
+    def _loss():
+        nll = -jnp.sum(logp * onehot, axis=-1, keepdims=True)
+        loss_scr[0, 0] += jnp.sum(nll * mask)
+
+    @pl.when(jnp.logical_and(s == k, t == ntiles - 1))
+    def _emit():
+        dw1_ref[:] = w1_scr[:] - w10_ref[:]
+        db1_ref[:] = b1_scr[:] - b10_ref[:]
+        dw2_ref[:] = w2_scr[:] - w20_ref[:]
+        db2_ref[:] = b2_scr[:] - b20_ref[:]
+        loss_ref[0, 0] = loss_scr[0, 0] / denom
+
+
+def _mlp_stream_kernel(x_ref, y_ref, mask_ref, *rest, k, lr, num_rows,
+                       ntiles):
+    _mlp_stream_core(x_ref[:].astype(jnp.float32), y_ref[:], mask_ref[:],
+                     *rest, k=k, lr=lr, num_rows=num_rows, ntiles=ntiles)
+
+
+def _mlp_stream_kernel_q(q_ref, scale_ref, y_ref, mask_ref, *rest, k, lr,
+                         num_rows, ntiles):
+    x = q_ref[:].astype(jnp.float32) * scale_ref[:]
+    _mlp_stream_core(x, y_ref[:], mask_ref[:], *rest,
+                     k=k, lr=lr, num_rows=num_rows, ntiles=ntiles)
+
+
+def _mlp_stream_update(theta, x, y, mask, *, cfg: ModelConfig, tile: int,
+                       interpret: bool):
+    from kafka_ps_tpu.models import mlp as mlp_mod
+
+    num_features = _slab_shape(x)[1]
+    kind = _slab_kind(x)
+    hidden = cfg.hidden_dim
+    h8 = hidden + (-hidden) % LANES
+    denom = jnp.maximum(jnp.sum(mask.astype(jnp.float32)),
+                        1.0).reshape(1, 1)
+    x, y, mask = _pad_rows(x, y, mask, tile)
+    ntiles = _slab_shape(x)[0] // tile
+
+    params = mlp_mod.unflatten(theta, cfg)
+    w1 = jnp.zeros((h8, num_features), jnp.float32
+                   ).at[:hidden].set(params.w1)
+    b1 = jnp.zeros((1, h8), jnp.float32).at[0, :hidden].set(params.b1)
+    w2 = jnp.zeros((LANES, h8), jnp.float32
+                   ).at[:cfg.num_rows, :hidden].set(params.w2)
+    b2 = jnp.zeros((1, LANES), jnp.float32
+                   ).at[0, :cfg.num_rows].set(params.b2)
+
+    def tmap(s, t):
+        return (t, 0)
+
+    def wmap(s, t):
+        return (0, 0)
+
+    def tspec(width):
+        return pl.BlockSpec((tile, width), tmap, memory_space=pltpu.VMEM)
+
+    def wspec(a, b):
+        return pl.BlockSpec((a, b), wmap, memory_space=pltpu.VMEM)
+
+    y2 = y.astype(jnp.int32).reshape(-1, 1)
+    m2 = mask.astype(jnp.float32).reshape(-1, 1)
+    if kind == "int8":
+        body, operands = _mlp_stream_kernel_q, (x.q, x.scale, y2, m2)
+        in_specs = [tspec(num_features), tspec(1), tspec(1), tspec(1)]
+    else:
+        body, operands = _mlp_stream_kernel, (x, y2, m2)
+        in_specs = [tspec(num_features), tspec(1), tspec(1)]
+    in_specs += [
+        wspec(h8, num_features), wspec(1, h8),
+        wspec(LANES, h8), wspec(1, LANES),
+        pl.BlockSpec((1, 1), wmap, memory_space=pltpu.SMEM),
+    ]
+
+    kernel = functools.partial(body, k=cfg.num_max_iter,
+                               lr=cfg.local_learning_rate,
+                               num_rows=cfg.num_rows, ntiles=ntiles)
+    # pscheck: disable=PS101 (traced only inside jit'd mlp_local_update, cached per (shape, dtype))
+    dw1, db1, dw2, db2, loss = pl.pallas_call(
+        kernel,
+        grid=(cfg.num_max_iter + 1, ntiles),
+        in_specs=in_specs,
+        out_specs=(
+            wspec(h8, num_features), wspec(1, h8),
+            wspec(LANES, h8), wspec(1, LANES),
+            pl.BlockSpec((1, 1), wmap, memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((h8, num_features), jnp.float32),
+            jax.ShapeDtypeStruct((1, h8), jnp.float32),
+            jax.ShapeDtypeStruct((LANES, h8), jnp.float32),
+            jax.ShapeDtypeStruct((1, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((h8, num_features), jnp.float32),
+            pltpu.VMEM((1, h8), jnp.float32),
+            pltpu.VMEM((LANES, h8), jnp.float32),
+            pltpu.VMEM((1, LANES), jnp.float32),
+            pltpu.VMEM((h8, num_features), jnp.float32),
+            pltpu.VMEM((1, h8), jnp.float32),
+            pltpu.VMEM((LANES, h8), jnp.float32),
+            pltpu.VMEM((1, LANES), jnp.float32),
+            pltpu.SMEM((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*operands, w1, b1, w2, b2, denom)
 
     delta = mlp_mod.flatten(mlp_mod.MLPParams(
         w1=dw1[:hidden], b1=db1[0, :hidden],
@@ -375,14 +854,21 @@ def local_update_batched(thetas: jax.Array, xs: jax.Array, ys: jax.Array,
     instance runs the identical kernel body on the identical block.
     Fallback rules match `local_update`, applied per-instance shapes
     (the grid holds one member's working set in VMEM at a time); the
-    fallback itself is the vmapped XLA path."""
-    k, batch, num_features = xs.shape
+    fallback itself is the vmapped XLA path.  Reduced-precision slab
+    storage (bf16/int8, compress/slab.py) also takes the vmapped XLA
+    fallback here — the per-member tensors stack componentwise (the
+    gang's tree-stack) and logreg.local_update decodes internally;
+    the streaming kernel stays a single-member construct."""
+    kind = _slab_kind(xs)
+    k = (xs.q if isinstance(xs, QuantizedSlab) else xs).shape[0]
+    batch, num_features = _slab_shape(xs)
     on_tpu = jax.default_backend() == "tpu"
-    if not (fits_in_vmem(batch, num_features) and (on_tpu or interpret)):
+    if not (kind == "f32" and fits_in_vmem(batch, num_features)
+            and (on_tpu or interpret)):
         if not allow_fallback:
             raise ValueError(
                 f"pallas local_update_batched unavailable (k={k}, "
-                f"batch={batch}, features={num_features}, "
+                f"batch={batch}, features={num_features}, slab={kind}, "
                 f"backend={jax.default_backend()})")
         return jax.vmap(
             lambda t, x, y, m: logreg.local_update(t, x, y, m, cfg=cfg)
@@ -458,19 +944,25 @@ def mlp_local_update_batched(thetas: jax.Array, xs: jax.Array,
                              ) -> tuple[jax.Array, jax.Array]:
     """k independent MLP local updates as ONE device step — the MLP
     counterpart of `local_update_batched`; row i equals
-    mlp_local_update(thetas[i], ...) bitwise."""
+    mlp_local_update(thetas[i], ...) bitwise.  Reduced-precision slabs
+    take the vmapped XLA fallback (decode inside MLPTask.local_update),
+    as in local_update_batched."""
     from kafka_ps_tpu.models import mlp as mlp_mod
 
-    k, batch, num_features = xs.shape
+    kind = _slab_kind(xs)
+    k = (xs.q if isinstance(xs, QuantizedSlab) else xs).shape[0]
+    batch, num_features = _slab_shape(xs)
     hidden = cfg.hidden_dim
     on_tpu = jax.default_backend() == "tpu"
-    if not (mlp_fits_in_vmem(batch, num_features, hidden)
+    if not (kind == "f32" and mlp_fits_in_vmem(batch, num_features,
+                                               hidden)
             and (on_tpu or interpret)):
         if not allow_fallback:
             raise ValueError(
                 f"pallas mlp_local_update_batched unavailable (k={k}, "
                 f"batch={batch}, features={num_features}, "
-                f"hidden={hidden}, backend={jax.default_backend()})")
+                f"hidden={hidden}, slab={kind}, "
+                f"backend={jax.default_backend()})")
         task = mlp_mod.MLPTask(cfg)
         return jax.vmap(task.local_update)(thetas, xs, ys, masks)
 

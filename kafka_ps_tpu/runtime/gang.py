@@ -110,10 +110,17 @@ def _gang_solver_fns(task_name: str, cfg, use_pallas: bool,
     def unstack(a, k):
         return tuple(a[i] for i in range(k))
 
+    def tstack(items):
+        # componentwise stack: identical to jnp.stack for plain member
+        # slabs, and stacks QuantizedSlab (int8 slab storage,
+        # compress/slab.py) field-by-field — vmap then maps over the
+        # leading axis of every leaf, preserving per-element semantics
+        return jax.tree.map(lambda *leaves: jnp.stack(leaves), *items)
+
     @jax.jit
     def update_stacked(thetas, xs, ys, masks):
         k = len(xs)
-        deltas, losses = solver_b(jnp.stack(thetas), jnp.stack(xs),
+        deltas, losses = solver_b(jnp.stack(thetas), tstack(xs),
                                   jnp.stack(ys), jnp.stack(masks))
         return unstack(deltas, k), unstack(losses, k)
 
@@ -122,18 +129,18 @@ def _gang_solver_fns(task_name: str, cfg, use_pallas: bool,
         k = len(xs)
         if use_pallas:
             thetas = jnp.broadcast_to(theta[None], (k,) + theta.shape)
-            deltas, losses = solver_b(thetas, jnp.stack(xs),
+            deltas, losses = solver_b(thetas, tstack(xs),
                                       jnp.stack(ys), jnp.stack(masks))
         else:
             deltas, losses = jax.vmap(solver_1, in_axes=(None, 0, 0, 0))(
-                theta, jnp.stack(xs), jnp.stack(ys), jnp.stack(masks))
+                theta, tstack(xs), jnp.stack(ys), jnp.stack(masks))
         return unstack(deltas, k), unstack(losses, k)
 
     @jax.jit
     def update_eval_stacked(thetas, xs, ys, masks, test_x, test_y):
         k = len(xs)
         T = jnp.stack(thetas)
-        X, Y, M = jnp.stack(xs), jnp.stack(ys), jnp.stack(masks)
+        X, Y, M = tstack(xs), jnp.stack(ys), jnp.stack(masks)
         if use_pallas:
             deltas, losses = solver_b(T, X, Y, M)
             met = jax.vmap(lambda t, d: task.evaluate(t + d, test_x,
@@ -149,7 +156,7 @@ def _gang_solver_fns(task_name: str, cfg, use_pallas: bool,
     @jax.jit
     def update_eval_bcast(theta, xs, ys, masks, test_x, test_y):
         k = len(xs)
-        X, Y, M = jnp.stack(xs), jnp.stack(ys), jnp.stack(masks)
+        X, Y, M = tstack(xs), jnp.stack(ys), jnp.stack(masks)
         if use_pallas:
             thetas = jnp.broadcast_to(theta[None], (k,) + theta.shape)
             deltas, losses = solver_b(thetas, X, Y, M)

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kafka_ps_tpu.compress import slab as slab_mod
 from kafka_ps_tpu.data.buffer import SlidingBuffer
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime.messages import GradientMessage, KeyRange, WeightsMessage
@@ -97,13 +98,15 @@ class WorkerNode:
         self.test_x = jnp.asarray(test_x) if test_x is not None else None
         self.test_y = jnp.asarray(test_y) if test_y is not None else None
         self.log = log or (lambda line: None)
-        # device slab cache: between stream arrivals the worker trains
-        # on identical buffer contents; re-uploading the unchanged slab
-        # every iteration would make host->device transfer the
-        # bottleneck (num_tuples_seen strictly increases per insert, so
-        # it is the content version — same scheme as run_fused_bsp)
+        # Device-resident slab (compress/slab.SlabStore,
+        # docs/PERFORMANCE.md): the buffer slab lives on device in
+        # cfg.slab_dtype storage, keyed by the buffer's mutation
+        # counter.  Steady state uploads only the dirty rows
+        # (O(changed rows) bytes) via a jit'd scatter; the full
+        # re-upload remains the bootstrap/restore/mass-churn fallback.
         self._slab_version: int | None = None
-        self._slab = None
+        self._slab_store = slab_mod.SlabStore(
+            cfg.slab_dtype, buffer.cfg.max_size, buffer.num_features)
         self.iterations = 0
         # iterations counted at (re)admission: the supervisor grants the
         # jit-compile grace to the first iteration *since joining*, not
@@ -154,11 +157,22 @@ class WorkerNode:
             # Empty-buffer invariant (WorkerTrainingProcessor.java:131-133).
             raise RuntimeError(
                 f"There is no data in the buffer of worker {self.worker_id}")
-        if seen != self._slab_version:
-            x, y, mask = self.buffer.snapshot()
-            self._slab = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask))
-            self._slab_version = seen
-        x, y, mask = self._slab
+        ver = self.buffer.version
+        if ver != self._slab_version:
+            store = self._slab_store
+            if not (self.cfg.slab_incremental and store.ready):
+                store.upload_full(*self.buffer.snapshot(clear_dirty=True))
+            else:
+                slots, xr, yr, mr = self.buffer.drain_dirty()
+                if 2 * len(slots) >= store.capacity:
+                    # mass churn (target-shrink delete storms, restore):
+                    # one contiguous upload beats a near-full scatter
+                    store.upload_full(
+                        *self.buffer.snapshot(clear_dirty=True))
+                elif len(slots):
+                    store.apply_rows(slots, xr, yr, mr)
+            self._slab_version = ver
+        x, y, mask = self._slab_store.arrays()
         want_eval = (self.test_x is not None
                      and msg.vector_clock % self.cfg.eval_every == 0)
         return jnp.asarray(self.theta), x, y, mask, seen, want_eval

@@ -113,6 +113,16 @@ class PSConfig:
     # without the feature.  Incompatible with the fused BSP path (its
     # collectives never cross a serde boundary).
     compress: str = "none"
+    # Device-resident training slab (compress/slab.py,
+    # docs/PERFORMANCE.md).  slab_dtype: "f32" | "bf16" | "int8" — the
+    # storage precision of each worker's on-device slab; decode is
+    # fused into the training step.  "f32" is bitwise-identical to a
+    # build without the feature.  slab_incremental: scatter only dirty
+    # buffer rows into the device slab instead of re-uploading the
+    # whole slab on every arrival (full upload remains the fallback
+    # for bootstrap, restore, and mass-delete churn).
+    slab_dtype: str = "f32"
+    slab_incremental: bool = True
     # Online serving plane (kafka_ps_tpu/serving/): disabled by default —
     # attaching it never perturbs training (snapshots alias the
     # immutable device theta), but the engine thread only exists when

@@ -20,6 +20,12 @@
 # completion, and strictly fewer bytes on the wire than the
 # uncompressed arm (docs/COMPRESSION.md).
 #
+# `scripts/tier1.sh --perf` runs the incremental-slab smoke leg: tiny
+# serial runs asserting the incremental device slab trains to a
+# BITWISE-identical theta vs whole-slab re-upload (f32, all three
+# consistency models) and that bf16 slab storage trains end-to-end
+# (docs/PERFORMANCE.md).
+#
 # `scripts/tier1.sh --analyze` runs the static-analysis leg: pscheck
 # (docs/ANALYSIS.md) over the package — fails on ANY unsuppressed
 # finding — plus ruff (pyproject.toml, rule sets E/F/B/PLE) when the
@@ -235,6 +241,46 @@ assert theta_on.tobytes() == theta_off.tobytes(), \
 assert disp_on < disp_off, \
     f"gang smoke: dispatch count did not drop ({disp_on} vs {disp_off})"
 print(f"GANG_SMOKE_OK dispatches {disp_on} vs {disp_off} per-message")
+EOF
+    exit $?
+fi
+
+if [[ "${1:-}" == "--perf" ]]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+from kafka_ps_tpu.runtime.app import StreamingPSApp
+from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig, PSConfig,
+                                       StreamConfig)
+
+def run(consistency, slab_dtype, incremental):
+    cfg = PSConfig(num_workers=4, consistency_model=consistency,
+                   model=ModelConfig(num_features=8, num_classes=2,
+                                     local_learning_rate=0.5),
+                   buffer=BufferConfig(min_size=8, max_size=32),
+                   stream=StreamConfig(time_per_event_ms=1.0),
+                   slab_dtype=slab_dtype, slab_incremental=incremental)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 8)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32) + 1
+    app = StreamingPSApp(cfg, test_x=x, test_y=y)
+    for i in range(128):
+        app.buffers[i % 4].add({j: float(x[i, j]) for j in range(8)},
+                               int(y[i]))
+    app.run_serial(24)
+    assert app.server.iterations >= 24, app.server.iterations
+    theta = np.asarray(app.server.theta)
+    assert np.isfinite(theta).all(), f"non-finite theta ({slab_dtype})"
+    return theta
+
+for c in (0, 2, -1):
+    # f32 contract: the incremental scatter path is BITWISE-invisible
+    inc = run(c, "f32", incremental=True)
+    full = run(c, "f32", incremental=False)
+    assert inc.tobytes() == full.tobytes(), \
+        f"perf smoke: incremental f32 slab diverged at consistency={c}"
+    # bf16 slab storage trains end-to-end on every consistency model
+    run(c, "bf16", incremental=True)
+print("PERF_SMOKE_OK f32 bitwise + bf16 e2e at consistency 0/2/-1")
 EOF
     exit $?
 fi
