@@ -510,6 +510,116 @@ def slab_ab(iters: int = 30, warm: int = 5) -> dict:
     return out
 
 
+def telemetry_overhead(iters: int = 40, trials: int = 5) -> dict:
+    """Telemetry-overhead gate (docs/OBSERVABILITY.md): the SAME
+    message-driven workload with instrumentation off (the default
+    NULL_TELEMETRY fast path) vs fully on (Tracer + metrics registry),
+    trials interleaved so drift hits every arm equally.
+
+    Auditable claims: enabled telemetry costs < 5% server iters/s
+    (asserted — the observability plane must not tax the training
+    plane) and the instrumented arm ends BITWISE-identical to the
+    uninstrumented one (instrumentation reads host scalars only, PS106
+    — it must not perturb what it measures).  The `null` arm passes
+    NULL_TELEMETRY explicitly — same object the default resolves to, so
+    its delta vs `off` is the pure measurement noise floor the
+    overhead_pct number should be read against."""
+    from kafka_ps_tpu.data.synth import generate_hard
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+    from kafka_ps_tpu.telemetry import NULL_TELEMETRY, Telemetry
+    from kafka_ps_tpu.utils.config import BufferConfig, ModelConfig, PSConfig
+    from kafka_ps_tpu.utils.trace import Tracer
+
+    num_workers, cap = 4, 256
+    model = ModelConfig()
+    x, y = generate_hard(num_workers * cap, seed=11)
+    telemetry_on = Telemetry(tracer=Tracer())
+
+    def build(telemetry):
+        pcfg = PSConfig(num_workers=num_workers, consistency_model=0,
+                        model=model, eval_every=10 ** 9,
+                        buffer=BufferConfig(max_size=cap))
+        tracer = telemetry.tracer if telemetry is not None else None
+        app = StreamingPSApp(pcfg, tracer=tracer, telemetry=telemetry)
+        for i in range(num_workers * cap):
+            app.data_sink(i % num_workers, dict(enumerate(x[i])), int(y[i]))
+        app.run_serial(max_server_iterations=4)      # compile
+        return app, {"done": 4}
+
+    apps = {"off": build(None), "null": build(NULL_TELEMETRY),
+            "on": build(telemetry_on)}
+
+    def runner(key):
+        app, state = apps[key]
+
+        def run():
+            state["done"] += iters
+            app.run_serial(max_server_iterations=state["done"])
+        return run
+
+    fns = {k: runner(k) for k in apps}
+    for fn in fns.values():
+        fn()                                        # warm every arm
+    ab = interleaved_rates(fns, iters, trials)
+    stats = {k: rate_stats(rs, round_to=2) for k, rs in ab.items()}
+    off_med = stats["off"]["median"]
+    overhead = (off_med - stats["on"]["median"]) / off_med * 100
+    null_delta = (off_med - stats["null"]["median"]) / off_med * 100
+    # bitwise contract: every arm ran the identical deterministic
+    # schedule, so the instrumented theta must equal the plain one
+    thetas = {k: np.asarray(app.server.theta).tobytes()
+              for k, (app, _) in apps.items()}
+    bitwise = thetas["off"] == thetas["on"] == thetas["null"]
+    assert bitwise, "telemetry-on arm diverged from the uninstrumented arm"
+    assert overhead < 5.0, f"telemetry overhead {overhead:.1f}% >= 5%"
+    return {
+        "iters_per_trial": iters,
+        "off_iters_per_sec": stats["off"],
+        "null_iters_per_sec": stats["null"],
+        "on_iters_per_sec": stats["on"],
+        "overhead_pct": round(overhead, 2),
+        "disabled_path_delta_pct": round(null_delta, 2),
+        "theta_bitwise_identical": bitwise,
+        "on_arm_spans": sum(
+            s["count"] for s in telemetry_on.tracer.span_stats().values()),
+        "on_arm_metric_families": len(telemetry_on.snapshot()),
+    }
+
+
+def staleness_block(iters: int = 60) -> dict:
+    """Consistency-model staleness distributions (docs/OBSERVABILITY.md):
+    the gate-wait and vector-clock-lag histograms runtime/server.py
+    records at gate-decision time, one run per model — BSP's lag-0
+    spike vs the bounded model's capped tail vs eventual's free drift,
+    as numbers instead of prose."""
+    from kafka_ps_tpu.data.synth import generate_hard
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+    from kafka_ps_tpu.telemetry import Telemetry, model_name
+    from kafka_ps_tpu.utils.config import BufferConfig, ModelConfig, PSConfig
+
+    num_workers, cap = 4, 256
+    model = ModelConfig()
+    x, y = generate_hard(num_workers * cap, seed=13)
+    out: dict = {}
+    for c in (0, 2, -1):
+        telemetry = Telemetry()
+        pcfg = PSConfig(num_workers=num_workers, consistency_model=c,
+                        model=model, eval_every=10 ** 9,
+                        buffer=BufferConfig(max_size=cap))
+        app = StreamingPSApp(pcfg, telemetry=telemetry)
+        for i in range(num_workers * cap):
+            app.data_sink(i % num_workers, dict(enumerate(x[i])), int(y[i]))
+        app.run_serial(max_server_iterations=iters)
+        snap = telemetry.snapshot()
+        label = f"model={model_name(c)}"
+        out[model_name(c)] = {
+            "consistency_model": c,
+            "gate_wait_ms": snap["gate_wait_ms"][label],
+            "clock_lag": snap["clock_lag"][label],
+        }
+    return out
+
+
 def runtime_mlp4096(trials: int) -> tuple[dict, float]:
     """MLP-4096 through the FULL PS runtime — the loop `cli/run.py
     --fused --task mlp --hidden_dim 4096` drives (StreamingPSApp
@@ -823,6 +933,10 @@ def main() -> None:
         slab_roofs.append({"slab_dtype": sd,
                            "worker_updates_per_sec": ups, **roof})
 
+    # -- telemetry plane: overhead gate + staleness distributions ----------
+    telemetry = telemetry_overhead()
+    staleness = staleness_block()
+
     baseline = 1.85   # best aggregate worker-updates/s in reference logs
     payload = {
         "metric": "worker_updates_per_sec",
@@ -852,6 +966,8 @@ def main() -> None:
                 "serving_ab": serving,
                 "compression_ab": compression,
                 "slab_ab": slab,
+                "telemetry_overhead": telemetry,
+                "staleness": staleness,
             },
             "roofline": {
                 "device_kind": getattr(dev, "device_kind", "unknown"),
@@ -907,6 +1023,12 @@ def main() -> None:
             "slab_bytes_ratio_f32": slab[
                 "f32_bytes_ratio_full_over_incremental"],
             "slab_int8_hbm_ratio": slab["int8_device_bytes_ratio_vs_f32"],
+            "telemetry_overhead_pct": telemetry["overhead_pct"],
+            "telemetry_bitwise": telemetry["theta_bitwise_identical"],
+            "gate_wait_p50_ms_sequential": staleness["sequential"][
+                "gate_wait_ms"].get("p50"),
+            "clock_lag_p95_eventual": staleness["eventual"][
+                "clock_lag"].get("p95"),
         },
         "detail_file": "bench_out.json",
     })

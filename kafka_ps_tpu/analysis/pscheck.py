@@ -21,6 +21,14 @@ time; these rules catch the regressions at commit time instead:
          bare ``set(...)`` (hash order) — replay must be bitwise.
   PS105  blocking I/O (socket send/recv, frame send/recv, ``fsync``,
          ``time.sleep``) while holding a lock.
+  PS106  host-sync calls (``.item()``, ``float()``, ``np.asarray``,
+         ``np.array``, ``.block_until_ready()``) inside the ARGUMENTS
+         of a telemetry/trace call (``span``, ``count``, ``observe``,
+         ``inc``, ``flow_*``) in ``runtime/``, ``ops/`` or
+         ``serving/`` — instrumentation must observe host scalars
+         only; a metric that syncs the device perturbs the very
+         latency it measures and breaks the telemetry-off/on bitwise
+         contract (docs/OBSERVABILITY.md).
 
 Suppression syntax, on the finding line or the line directly above::
 
@@ -56,6 +64,8 @@ RULES: dict[str, str] = {
     "PS104": "nondeterminism in a replay-critical module "
              "(log/, compress/, runtime/serde.py)",
     "PS105": "blocking I/O while holding a lock",
+    "PS106": "host-sync call inside the arguments of a telemetry/trace "
+             "call in runtime/, ops/ or serving/",
 }
 
 # -- rule scoping ----------------------------------------------------------
@@ -76,6 +86,15 @@ HANDLER_NAMES = frozenset({
 _NP_NAMES = frozenset({"np", "numpy"})
 _SYNC_ATTRS = frozenset({"item", "block_until_ready"})
 _NP_SYNC_ATTRS = frozenset({"asarray", "array"})
+
+# PS106: attribute-call names that record telemetry (utils/trace.Tracer
+# + telemetry/registry metric children).  `.set` is deliberately absent
+# — it collides with jax's `.at[...].set(...)`; gauge .set sites are
+# covered by the generic PS102 handler scoping instead.
+_TELEMETRY_ATTRS = frozenset({
+    "span", "count", "observe", "inc",
+    "flow", "flow_start", "flow_step", "flow_end",
+})
 
 # PS104 banned call roots
 _TIME_BANNED = frozenset({"time", "time_ns"})          # time.time(_ns)
@@ -404,6 +423,36 @@ class _Checker(ast.NodeVisitor):
                     f"float(...) host-syncs inside handler {handler!r} — "
                     "defer via asynclog futures")
 
+        # PS106 — host sync inside telemetry-call arguments: the metric/
+        # span/flow machinery must be handed host scalars, never device
+        # values it would have to fetch
+        if ("PS106" in self.scope
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _TELEMETRY_ATTRS):
+            for arg in [*node.args, *(kw.value for kw in node.keywords)]:
+                for sub in ast.walk(arg):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    sync = None
+                    if (isinstance(sub.func, ast.Name)
+                            and sub.func.id == "float"):
+                        sync = "float(...)"
+                    elif isinstance(sub.func, ast.Attribute):
+                        if sub.func.attr in _SYNC_ATTRS:
+                            sync = f".{sub.func.attr}()"
+                        elif (sub.func.attr in _NP_SYNC_ATTRS
+                                and isinstance(sub.func.value, ast.Name)
+                                and sub.func.value.id in _NP_NAMES):
+                            sync = f"{_dotted(sub.func)}(...)"
+                    if sync is not None:
+                        self.emit(
+                            "PS106", sub.lineno,
+                            f"{sync} host-syncs inside the arguments of "
+                            f".{node.func.attr}(...) — record host "
+                            "scalars (perf_counter deltas, ints, "
+                            ".nbytes); a syncing metric perturbs what "
+                            "it measures")
+
         # PS103 — re-encoding on the wire path
         if (isinstance(node.func, ast.Attribute)
                 and node.func.attr == "encode"
@@ -471,6 +520,8 @@ def _rules_for(path: Path) -> set:
     rules = {"PS100", "PS101", "PS105"}
     if "runtime" in parts or "serving" in parts:
         rules.add("PS102")
+    if "runtime" in parts or "ops" in parts or "serving" in parts:
+        rules.add("PS106")
     if path.name in ("serde.py", "net.py"):
         rules.add("PS103")
     if ("log" in parts or "compress" in parts
@@ -522,7 +573,7 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m kafka_ps_tpu.analysis",
         description="pscheck: project-invariant static analyzer "
-                    "(rules PS100-PS105)")
+                    "(rules PS100-PS106)")
     ap.add_argument("paths", nargs="*", default=["kafka_ps_tpu"],
                     help="files or directories to analyze "
                          "(default: kafka_ps_tpu)")

@@ -95,6 +95,18 @@ def build_parser(include_server_flags: bool = True,
                    help="write a Chrome trace-event JSON (spans + message "
                         "counters) on exit and print span stats — replaces "
                         "the reference's Confluent monitoring interceptors")
+    p.add_argument("--metrics-file", dest="metrics_file", default=None,
+                   metavar="PATH",
+                   help="enable the metrics registry "
+                        "(kafka_ps_tpu/telemetry/) and write a "
+                        "Prometheus-style text dump of every counter/"
+                        "gauge/histogram family to PATH at exit (and "
+                        "every --metrics-every seconds); also folds a "
+                        "flat metrics summary into each [status] line")
+    p.add_argument("--metrics-every", dest="metrics_every", type=float,
+                   default=0.0, metavar="SECONDS",
+                   help="with --metrics-file: rewrite the dump every N "
+                        "seconds (atomic replace; 0 = only at exit)")
     p.add_argument("--device_trace", default=None, metavar="LOGDIR",
                    help="capture a jax.profiler device trace (TensorBoard "
                         "logdir) for the whole run")
@@ -255,16 +267,19 @@ def make_app_from_args(args, resuming: bool = False,
     if getattr(args, "trace", None):
         from kafka_ps_tpu.utils.trace import Tracer
         tracer = Tracer()
+    from kafka_ps_tpu.telemetry import maybe_telemetry
+    telemetry = maybe_telemetry(
+        tracer, want_metrics=bool(getattr(args, "metrics_file", None)))
     fabric = None
     if getattr(args, "durable_log", None):
         from kafka_ps_tpu.log import DurableFabric, LogConfig
         fabric = DurableFabric(
             args.durable_log,
             LogConfig(fsync=getattr(args, "fsync", "interval")),
-            tracer=tracer)
+            tracer=tracer, telemetry=telemetry)
     app = StreamingPSApp(cfg, test_x=test_x, test_y=test_y,
                          server_log=server_log, worker_log=worker_log,
-                         tracer=tracer, fabric=fabric)
+                         tracer=tracer, fabric=fabric, telemetry=telemetry)
     return app, (server_log, worker_log)
 
 
@@ -428,7 +443,9 @@ def run_with_args(args) -> int:
         if getattr(args, "serve_port", None) is not None:
             from kafka_ps_tpu.runtime import net
             serve_bridge = net.ServerBridge(port=args.serve_port,
-                                            run_id=app.server.run_id)
+                                            run_id=app.server.run_id,
+                                            tracer=app.tracer,
+                                            telemetry=app.telemetry)
             serve_bridge.attach_serving(engine)
             print(f"serving on port {serve_bridge.port}",
                   file=sys.stderr, flush=True)
@@ -470,6 +487,13 @@ def run_with_args(args) -> int:
         if distributed:
             local_pos = multihost.local_worker_ids(len(active), mesh)
             app.local_workers = {active[i] for i in local_pos}
+
+    metrics_file = getattr(args, "metrics_file", None)
+    if metrics_file and getattr(args, "metrics_every", 0.0) > 0:
+        # periodic Prometheus-style dump (atomic replace) so an external
+        # scraper/tail can watch a long run; the exit path below writes
+        # the final state either way
+        app.telemetry.start_dumper(metrics_file, args.metrics_every)
 
     producer = app.make_producer(args.training_data_file_path)
     producer.run_in_background()
@@ -517,6 +541,9 @@ def run_with_args(args) -> int:
         app.close_logs()
         for log in logs:
             log.close()
+        if metrics_file:
+            app.telemetry.stop_dumper()
+            app.telemetry.write_prometheus(metrics_file)
         if args.trace:
             import json as _json
             print(app.tracer.dump(args.trace), file=sys.stderr)

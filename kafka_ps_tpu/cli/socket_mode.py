@@ -73,6 +73,32 @@ def _codec_spec(args):
         raise SystemExit(f"--compress: {e}") from None
 
 
+def _make_telemetry(args):
+    """Per-process observability handles (docs/OBSERVABILITY.md): each
+    split-mode process owns its own Tracer (pid-stamped events — the
+    merge CLI stitches the per-process dumps) and metrics registry."""
+    from kafka_ps_tpu.telemetry import maybe_telemetry
+    tracer = None
+    if getattr(args, "trace", None):
+        from kafka_ps_tpu.utils.trace import Tracer
+        tracer = Tracer()
+    telemetry = maybe_telemetry(
+        tracer, want_metrics=bool(getattr(args, "metrics_file", None)))
+    if getattr(args, "metrics_file", None) \
+            and getattr(args, "metrics_every", 0.0) > 0:
+        telemetry.start_dumper(args.metrics_file, args.metrics_every)
+    return tracer, telemetry
+
+
+def _dump_telemetry(args, tracer, telemetry) -> None:
+    """Exit-path flush for _make_telemetry (mirrors cli/run.py)."""
+    if getattr(args, "metrics_file", None):
+        telemetry.stop_dumper()
+        telemetry.write_prometheus(args.metrics_file)
+    if getattr(args, "trace", None) and tracer is not None:
+        print(tracer.dump(args.trace), file=sys.stderr, flush=True)
+
+
 class _BatchingSink:
     """Producer sink that coalesces stream rows into T_DATA_BATCH frames.
 
@@ -185,16 +211,19 @@ def run_server(args) -> int:
         run_id = ckpt.peek_run_id(checkpoint_path)
     if run_id is None:
         run_id = time.time_ns()
+    tracer, telemetry = _make_telemetry(args)
     bridge = net.ServerBridge(
         port=args.listen,
         heartbeat_interval=min(1.0, hb_timeout / 3) if hb_timeout else 1.0,
         heartbeat_timeout=hb_timeout,
         run_id=run_id,
-        codec=codec_spec)
+        codec=codec_spec,
+        tracer=tracer, telemetry=telemetry)
     print(f"listening on port {bridge.port}", file=sys.stderr, flush=True)
     from kafka_ps_tpu.utils.asynclog import DeferredSink
     fabric = bridge.wrap(fabric_mod.Fabric())
-    server = ServerNode(cfg, fabric, test_x, test_y, DeferredSink(log))
+    server = ServerNode(cfg, fabric, test_x, test_y, DeferredSink(log),
+                        tracer=tracer, telemetry=telemetry)
     if codec_spec.codec_id != net.CODEC_NONE:
         # weights leave this process quantize-dequantized so both sides
         # train against the SAME decoded theta; per-connection fallback
@@ -230,7 +259,8 @@ def run_server(args) -> int:
         engine = PredictionEngine(
             server.task, registry,
             max_batch=getattr(args, "serve_batch", 16),
-            deadline_s=getattr(args, "serve_deadline_ms", 2.0) / 1000.0)
+            deadline_s=getattr(args, "serve_deadline_ms", 2.0) / 1000.0,
+            tracer=tracer, telemetry=telemetry)
         bridge.attach_serving(engine)
         server.publish_snapshot()    # cold start: restored/fresh theta
         print(f"serving predictions on port {bridge.port}",
@@ -335,6 +365,8 @@ def run_server(args) -> int:
             out["serving"] = {"occ": s["occupancy"],
                               "p50_ms": s["p50_ms"], "p99_ms": s["p99_ms"],
                               "stale": s["rejections"]}
+        if telemetry.enabled:
+            out["metrics"] = telemetry.summary()
         return out
 
     reporter = StatusReporter(getattr(args, "status_every", 0.0) or 0.0,
@@ -372,6 +404,7 @@ def run_server(args) -> int:
                   f"{bridge.dropped_sends}", file=sys.stderr, flush=True)
         server.log.close()           # joins drain thread + closes sink
         events_log.close()
+        _dump_telemetry(args, tracer, telemetry)
     return 0
 
 
@@ -393,10 +426,12 @@ def run_worker(args) -> int:
     # and the NEGOTIATED codec — compression runs at what the server
     # agreed to, not at what this process asked for (a mixed-version
     # server replies NONE and both sides ship plain frames)
+    tracer, telemetry = _make_telemetry(args)
     bridge = net.WorkerBridge(
         host or "127.0.0.1", int(port), ids,
         heartbeat_timeout=getattr(args, "heartbeat_timeout", None),
-        codec=_codec_spec(args))
+        codec=_codec_spec(args),
+        tracer=tracer, telemetry=telemetry)
     fabric = bridge.make_fabric()
 
     compressors = None
@@ -448,7 +483,8 @@ def run_worker(args) -> int:
             fh.write(str(bridge.server_run_id))
     log = CsvLogSink(log_path, WORKER_HEADER, append=append_log)
 
-    buffers = {w: SlidingBuffer(cfg.model.num_features, cfg.buffer)
+    buffers = {w: SlidingBuffer(cfg.model.num_features, cfg.buffer,
+                                telemetry=telemetry, worker=w)
                for w in ids}
     if restoring:
         from kafka_ps_tpu.utils import checkpoint as ckpt
@@ -462,7 +498,7 @@ def run_worker(args) -> int:
     from kafka_ps_tpu.utils.asynclog import DeferredSink
     worker_log = DeferredSink(log)
     nodes = {w: WorkerNode(w, cfg, fabric, buffers[w], test_x, test_y,
-                           worker_log)
+                           worker_log, tracer=tracer, telemetry=telemetry)
              for w in ids}
     if compressors is not None:
         for w in ids:
@@ -580,6 +616,9 @@ def run_worker(args) -> int:
     for t in (reader_thread, ready_thread):
         if t.is_alive():
             leftover.append(t.name)
+    # dump BEFORE the potential os._exit below — a wedged thread must
+    # not cost the process its trace/metrics files
+    _dump_telemetry(args, tracer, telemetry)
     rc = 0
     if errors:
         print(f"worker failed: {errors[0]!r}", file=sys.stderr, flush=True)

@@ -152,7 +152,8 @@ class SlabStore:
     audits (bench.py) — so the ~cap/changed-rows upload reduction is a
     measured number, not an estimate."""
 
-    def __init__(self, dtype: str, capacity: int, num_features: int):
+    def __init__(self, dtype: str, capacity: int, num_features: int,
+                 telemetry=None):
         if dtype not in SLAB_DTYPES:
             raise ValueError(
                 f"slab dtype {dtype!r} not in {SLAB_DTYPES}")
@@ -166,6 +167,16 @@ class SlabStore:
         self.full_uploads = 0
         self.incremental_applies = 0
         self.rows_applied = 0
+        # optional metrics mirror of bytes_uploaded (.nbytes of host
+        # arrays — no device sync), labeled by upload path
+        if telemetry is None:
+            from kafka_ps_tpu.telemetry import NULL_TELEMETRY
+            telemetry = NULL_TELEMETRY
+        self._telemetry = telemetry
+        self._m_full = telemetry.counter("slab_upload_bytes_total",
+                                         path="full")
+        self._m_rows = telemetry.counter("slab_upload_bytes_total",
+                                         path="incremental")
 
     @property
     def ready(self) -> bool:
@@ -178,6 +189,8 @@ class SlabStore:
         mask = np.ascontiguousarray(mask, dtype=np.float32)
         self.bytes_uploaded += x.nbytes + y.nbytes + mask.nbytes
         self.full_uploads += 1
+        if self._telemetry.enabled:
+            self._m_full.inc(x.nbytes + y.nbytes + mask.nbytes)
         full, _ = _slab_programs(self.dtype)
         self._x, self._y, self._mask = full(x, y, mask)
 
@@ -209,6 +222,9 @@ class SlabStore:
                                 + yr_p.nbytes + mr_p.nbytes)
         self.incremental_applies += 1
         self.rows_applied += n
+        if self._telemetry.enabled:
+            self._m_rows.inc(slots_p.nbytes + xr_p.nbytes
+                             + yr_p.nbytes + mr_p.nbytes)
         _, apply = _slab_programs(self.dtype)
         self._x, self._y, self._mask = apply(
             self._x, self._y, self._mask, slots_p, xr_p, yr_p, mr_p)

@@ -48,9 +48,17 @@ class SlidingBuffer:
     """Fixed-capacity masked ring buffer with a rate-adaptive target size."""
 
     def __init__(self, num_features: int, cfg: BufferConfig,
-                 clock_ms: Callable[[], float] | None = None):
+                 clock_ms: Callable[[], float] | None = None,
+                 telemetry=None, worker: int | None = None):
         self.cfg = cfg
         self.num_features = num_features
+        if telemetry is None:
+            from kafka_ps_tpu.telemetry import NULL_TELEMETRY
+            telemetry = NULL_TELEMETRY
+        self._telemetry = telemetry
+        self._m_rows = telemetry.counter(
+            "buffer_rows_ingested_total",
+            worker="all" if worker is None else str(worker))
         cap = cfg.max_size
         self.x = np.zeros((cap, num_features), dtype=np.float32)
         self.y = np.zeros((cap,), dtype=np.int32)
@@ -99,6 +107,8 @@ class SlidingBuffer:
         """Insert one sample, evicting per the dynamic-target policy."""
         with self._lock:
             self._add_locked(features, label)
+        if self._telemetry.enabled:
+            self._m_rows.inc()
 
     def add_many(self, rows) -> None:
         """Insert N (features, label) samples under ONE lock acquisition
@@ -106,9 +116,13 @@ class SlidingBuffer:
         ServerBridge.send_data_batch).  Policy-identical to N add()
         calls: arrival recording and the dynamic-target eviction run
         per row, only the lock round-trips are amortized."""
+        n = 0
         with self._lock:
             for features, label in rows:
                 self._add_locked(features, label)
+                n += 1
+        if n and self._telemetry.enabled:
+            self._m_rows.inc(n)
 
     def _add_locked(self, features, label: int) -> None:
         self._record_arrival()

@@ -46,9 +46,17 @@ class DurableFabric(Fabric):
     durable = True
 
     def __init__(self, root: str, config: LogConfig | None = None,
-                 tracer=None):
+                 tracer=None, telemetry=None):
         super().__init__(tracer)
-        self.manager = LogManager(root, config, tracer=self._tracer)
+        if telemetry is None:
+            from kafka_ps_tpu.telemetry import NULL_TELEMETRY
+            telemetry = NULL_TELEMETRY
+        self._telemetry = telemetry
+        self._m_replays = {
+            t: telemetry.counter("log_replays_total", topic=t)
+            for t in (WEIGHTS_TOPIC, GRADIENTS_TOPIC)}
+        self.manager = LogManager(root, config, tracer=self._tracer,
+                                  telemetry=telemetry)
         # next undelivered offset per partition; starts at the replay
         # position set by recover() and advances on every poll
         self._delivered: dict[tuple[str, int], int] = {}
@@ -229,6 +237,8 @@ class DurableFabric(Fabric):
                     q.append((offset, msg))
                     counts[topic] = counts.get(topic, 0) + 1
                     self._tracer.count(f"log.replays.{topic}")
+                    if self._telemetry.enabled:
+                        self._m_replays[topic].inc()
             self._cond.notify_all()
         return counts
 

@@ -53,10 +53,16 @@ class CommitLog:
     """Segmented append-only log for one partition."""
 
     def __init__(self, directory: str, config: LogConfig | None = None,
-                 tracer=None, name: str = ""):
+                 tracer=None, name: str = "", telemetry=None):
         self.directory = directory
         self.config = config or LogConfig()
         self.tracer = tracer or NULL_TRACER
+        if telemetry is None:
+            from kafka_ps_tpu.telemetry import NULL_TELEMETRY
+            telemetry = NULL_TELEMETRY
+        self.telemetry = telemetry
+        self._m_appends = telemetry.counter("log_appends_total")
+        self._m_fsync_ms = telemetry.histogram("log_fsync_ms")
         self.name = name or directory
         os.makedirs(directory, exist_ok=True)
         self.segments: list[LogSegment] = []
@@ -101,6 +107,8 @@ class CommitLog:
             self._roll()
         offset = self.active.append(payload)
         self.tracer.count("log.appends")
+        if self.telemetry.enabled:
+            self._m_appends.inc()
         self._maybe_fsync()
         return offset
 
@@ -119,18 +127,26 @@ class CommitLog:
         now = time.monotonic()
         if policy == "always" or \
                 now - self._last_fsync >= self.config.fsync_interval_s:
-            self.active.flush(sync=True)
+            self._timed_fsync()
             self._last_fsync = now
-            self.tracer.count("log.fsyncs")
         else:
             self.active.flush(sync=False)
+
+    def _timed_fsync(self) -> None:
+        """The single sync-flush site: the fsync stall IS the durability
+        tax --log-fsync buys, so its latency distribution is a first-
+        class metric (docs/DURABILITY.md trade-off table)."""
+        t0 = time.perf_counter()
+        self.active.flush(sync=True)
+        self.tracer.count("log.fsyncs")
+        if self.telemetry.enabled:
+            self._m_fsync_ms.observe((time.perf_counter() - t0) * 1e3)
 
     def flush(self) -> None:
         """Force an fsync of the active segment regardless of policy —
         called at clean shutdown and at commit points."""
-        self.active.flush(sync=True)
+        self._timed_fsync()
         self._last_fsync = time.monotonic()
-        self.tracer.count("log.fsyncs")
 
     # -- read --------------------------------------------------------------
 

@@ -37,10 +37,14 @@ class LogManager:
     no cross-process locking."""
 
     def __init__(self, root: str, config: LogConfig | None = None,
-                 tracer=None):
+                 tracer=None, telemetry=None):
         self.root = root
         self.config = config or LogConfig()
         self.tracer = tracer or NULL_TRACER
+        if telemetry is None:
+            from kafka_ps_tpu.telemetry import NULL_TELEMETRY
+            telemetry = NULL_TELEMETRY
+        self.telemetry = telemetry
         self._logs: dict[tuple[str, int], CommitLog] = {}
         self._offsets_dir = os.path.join(root, "offsets")
         os.makedirs(self._offsets_dir, exist_ok=True)
@@ -70,7 +74,8 @@ class LogManager:
         if log is None:
             log = CommitLog(os.path.join(self.root, topic, str(key)),
                             self.config, tracer=self.tracer,
-                            name=partition_key(topic, key))
+                            name=partition_key(topic, key),
+                            telemetry=self.telemetry)
             self._logs[(topic, key)] = log
         return log
 

@@ -31,6 +31,7 @@ from kafka_ps_tpu.parallel import bsp
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime.server import LogSink, ServerNode
 from kafka_ps_tpu.runtime.worker import WorkerNode
+from kafka_ps_tpu.telemetry import NULL_TELEMETRY
 from kafka_ps_tpu.utils import asynclog
 from kafka_ps_tpu.utils.asynclog import DeferredSink
 from kafka_ps_tpu.utils.config import PSConfig, SEQUENTIAL
@@ -48,25 +49,31 @@ class StreamingPSApp:
                  worker_log: LogSink | None = None,
                  clock_ms=None,
                  tracer=None,
-                 fabric=None):
+                 fabric=None,
+                 telemetry=None):
         self.tracer = tracer or NULL_TRACER
+        self.telemetry = telemetry or NULL_TELEMETRY
         self.cfg = cfg
         # callers may supply a durable fabric (log/durable_fabric.py,
         # `--durable-log`); default stays the volatile in-memory one
         self.fabric = fabric or fabric_mod.Fabric(tracer=self.tracer)
         self.buffers = [
-            SlidingBuffer(cfg.model.num_features, cfg.buffer, clock_ms=clock_ms)
-            for _ in range(cfg.num_workers)]
+            SlidingBuffer(cfg.model.num_features, cfg.buffer,
+                          clock_ms=clock_ms, telemetry=self.telemetry,
+                          worker=w)
+            for w in range(cfg.num_workers)]
         # deferred sinks: the per-node hot path logs device futures
         # (loss/F1/accuracy) without blocking on them — flushed when
         # ready and force-flushed at drive-loop exit (utils/asynclog)
         server_log = DeferredSink(server_log or (lambda line: None))
         worker_log = DeferredSink(worker_log or (lambda line: None))
         self.server = ServerNode(cfg, self.fabric, test_x, test_y, server_log,
-                                 tracer=self.tracer)
+                                 tracer=self.tracer,
+                                 telemetry=self.telemetry)
         self.workers = [
             WorkerNode(w, cfg, self.fabric, self.buffers[w], test_x, test_y,
-                       worker_log, tracer=self.tracer)
+                       worker_log, tracer=self.tracer,
+                       telemetry=self.telemetry)
             for w in range(cfg.num_workers)]
         # compressed delta transport (kafka_ps_tpu/compress/): one shared
         # weights compressor on the server, one error-feedback residual
@@ -233,7 +240,7 @@ class StreamingPSApp:
             self.server.task, registry,
             max_batch=scfg.max_batch,
             deadline_s=scfg.deadline_ms / 1000.0,
-            tracer=self.tracer)
+            tracer=self.tracer, telemetry=self.telemetry)
         return self.serving_engine
 
     def close_serving(self) -> None:
@@ -281,6 +288,10 @@ class StreamingPSApp:
             out["serving"] = {
                 "occ": s["occupancy"], "p50_ms": s["p50_ms"],
                 "p99_ms": s["p99_ms"], "stale": s["rejections"]}
+        if self.telemetry.enabled:
+            # flattened registry heartbeat (counter totals + histogram
+            # p50/n) rides the same [status] line as the runtime pulse
+            out["metrics"] = self.telemetry.summary()
         return out
 
     def _start_status(self, status_every: float | None):
@@ -317,7 +328,7 @@ class StreamingPSApp:
             return None
         from kafka_ps_tpu.runtime.gang import GangDispatcher
         return GangDispatcher(self.workers, self.fabric, self.cfg,
-                              tracer=self.tracer)
+                              tracer=self.tracer, telemetry=self.telemetry)
 
     def run_serial(self, max_server_iterations: int,
                    pump=None, status_every: float | None = None) -> None:

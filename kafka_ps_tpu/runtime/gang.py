@@ -196,11 +196,15 @@ class GangDispatcher:
     gang, claiming only siblings ALREADY enqueued (non-blocking polls,
     no sleeps — latecomers run solo on their own threads)."""
 
-    def __init__(self, workers, fabric, cfg, tracer=None):
+    def __init__(self, workers, fabric, cfg, tracer=None, telemetry=None):
         self.workers = {w.worker_id: w for w in workers}
         self.fabric = fabric
         self.cfg = cfg
         self.tracer = tracer or NULL_TRACER
+        from kafka_ps_tpu.telemetry import NULL_TELEMETRY
+        self.telemetry = telemetry or NULL_TELEMETRY
+        self._m_dispatches = self.telemetry.counter("gang_dispatches_total")
+        self._m_members = self.telemetry.counter("gang_members_total")
         self._offer_lock = OrderedLock("GangDispatcher.offer")
         # (worker_id, clock) -> the full member tuple of its notice
         self._notices: dict[tuple[int, int], tuple] = {}
@@ -408,6 +412,9 @@ class GangDispatcher:
         self.tracer.count("dispatch.device")
         self.tracer.count("gang.batched_dispatches")
         self.tracer.count("gang.batched_members", k)
+        if self.telemetry.enabled:
+            self._m_dispatches.inc()
+            self._m_members.inc(k)
         if with_eval:
             deltas, losses, f1s, accs = out
         else:

@@ -38,6 +38,18 @@ unpack_from, so an old peer simply never sees them and the pair falls
 back to uncompressed f32 frames — a `--compress none` fleet is
 byte-identical to before this field existed.
 
+Trace-context negotiation (docs/OBSERVABILITY.md) rides the same
+pattern: one `<u8 offer>` byte AFTER the codec trailer on HELLO (the
+worker offers 1 iff its tracer is on) and on CONFIG (the server answers
+1 iff the offer arrived AND its own tracer is on).  When the pair
+negotiates tracing ON, every WEIGHTS / GRADIENTS payload gains a
+16-byte `<u64 flow_id> <u64 parent_span>` suffix after the serde bytes;
+the receiver strips it before decoding and emits the matching Chrome
+flow event, so a delta's worker -> server -> serving lifecycle renders
+as one connected arrow chain in Perfetto after the merge CLI
+(`python -m kafka_ps_tpu.telemetry merge`).  Old peers never offer and
+never see a suffix — a legacy fleet stays byte-identical.
+
 Delivery properties preserved from the reference fabric: addressed
 per-worker delivery, per-connection FIFO (TCP), asynchronous buffering
 (the consistency gate never blocks on a send).  Cites:
@@ -59,6 +71,8 @@ from kafka_ps_tpu.compress.wire import NONE as CODEC_SPEC_NONE
 from kafka_ps_tpu.compress.wire import CODEC_NONE, CodecSpec
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime import serde
+from kafka_ps_tpu.telemetry import NULL_TELEMETRY
+from kafka_ps_tpu.utils.trace import NULL_TRACER
 
 _FRAME = struct.Struct("<IBq")          # length, topic, key
 (T_WEIGHTS, T_GRADIENTS, T_DATA, T_HELLO, T_READY,
@@ -77,6 +91,11 @@ TOPIC_NAMES = {T_WEIGHTS: fabric_mod.WEIGHTS_TOPIC,
 
 # the optional codec trailer on HELLO and CONFIG (negotiation above)
 _CODEC_TRAILER = struct.Struct("<Bf")
+# the optional trace-offer/answer byte AFTER the codec trailer
+_TRACE_TRAILER = struct.Struct("<B")
+# the per-message trace context suffixed to WEIGHTS/GRADIENTS payloads
+# when the pair negotiated tracing: <u64 flow_id> <u64 parent_span>
+_TRACE_CTX = struct.Struct("<QQ")
 
 # -- serving-plane payloads (kafka_ps_tpu/serving/, docs/SERVING.md) -------
 # PREDICT: the feature row plus the request's staleness bound; sentinel
@@ -165,6 +184,30 @@ def _read_codec_trailer(payload, offset: int) -> CodecSpec:
         return CODEC_SPEC_NONE
 
 
+def _read_trace_flag(payload, offset: int) -> bool:
+    """The optional <u8> trace offer/answer after the codec trailer;
+    False when absent (old peer)."""
+    if len(payload) < offset + _TRACE_TRAILER.size:
+        return False
+    (flag,) = _TRACE_TRAILER.unpack_from(payload, offset)
+    return bool(flag)
+
+
+def _frame_counters(telemetry):
+    """Pre-resolved per-topic (sent, received) counter children plus the
+    matching wire-byte counters, so the frame hot paths never hit the
+    registry's family lock.  All-null children when telemetry is off."""
+    sent = {t: (telemetry.counter("frames_sent", topic=name),
+                telemetry.counter("wire_bytes_total", topic=name,
+                                  direction="out"))
+            for t, name in TOPIC_NAMES.items()}
+    recv = {t: (telemetry.counter("frames_received", topic=name),
+                telemetry.counter("wire_bytes_total", topic=name,
+                                  direction="in"))
+            for t, name in TOPIC_NAMES.items()}
+    return sent, recv
+
+
 def force_close(sock: socket.socket) -> None:
     """shutdown + close: a plain close() does NOT wake a thread blocked
     in recv() on the same socket; shutdown(SHUT_RDWR) delivers EOF to
@@ -221,7 +264,8 @@ class ServerBridge:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  heartbeat_interval: float | None = None,
                  heartbeat_timeout: float | None = None,
-                 run_id: int = 0, codec: CodecSpec | None = None):
+                 run_id: int = 0, codec: CodecSpec | None = None,
+                 tracer=None, telemetry=None):
         # `run_id` identifies the logical RUN (fresh server start, or
         # the run a checkpoint resume continues — utils/checkpoint.py
         # persists it).  Advertised in T_CONFIG so worker processes can
@@ -233,6 +277,14 @@ class ServerBridge:
         # to a none-negotiated peer strip the encoded payload in _send
         self.codec = codec if codec is not None else CODEC_SPEC_NONE
         self._codec_of: dict[socket.socket, CodecSpec] = {}
+        self._tracer = tracer or NULL_TRACER
+        self._telemetry = telemetry or NULL_TELEMETRY
+        # per-connection trace negotiation (module docstring): True iff
+        # the peer offered AND this side's tracer is on
+        self._trace_of: dict[socket.socket, bool] = {}
+        # pre-resolved metric children: one dict lookup + one leaf-lock
+        # inc per frame on the hot path (null metrics when telemetry off)
+        self._m_sent, self._m_recv = _frame_counters(self._telemetry)
         # bytes on the wire per frame topic, both directions, including
         # the 13-byte frame header (the compression_ab bench reads this)
         self.wire_bytes: dict[int, int] = {}
@@ -389,6 +441,13 @@ class ServerBridge:
             # to, so a mixed fleet stays consistent
             message = dataclasses.replace(message, encoded=None)
         payload = serde.to_bytes(message) if message is not None else b""
+        if topic == T_WEIGHTS and self._trace_of.get(conn):
+            # open the weights flow: arrow from this send slice to the
+            # worker's matching net.recv (run_reader strips the suffix)
+            fid = self._tracer.new_flow_id()
+            with self._tracer.span("net.send", topic="weights", worker=key):
+                self._tracer.flow_start("weights.wire", fid, worker=key)
+            payload += _TRACE_CTX.pack(fid, 0)
         return self._send_raw(conn, topic, key, payload)
 
     def _send_raw(self, conn, topic, key, payload: bytes) -> bool:
@@ -405,6 +464,10 @@ class ServerBridge:
             with self._wire_lock:
                 self.wire_bytes[topic] = (self.wire_bytes.get(topic, 0)
                                           + _FRAME.size + len(payload))
+            if self._telemetry.enabled:
+                frames, nbytes = self._m_sent[topic]
+                frames.inc()
+                nbytes.inc(_FRAME.size + len(payload))
             return True
         except (ConnectionError, OSError):
             self.dropped_sends += count
@@ -458,6 +521,10 @@ class ServerBridge:
                     self.wire_bytes[topic] = (
                         self.wire_bytes.get(topic, 0)
                         + _FRAME.size + len(payload))
+                if self._telemetry.enabled:
+                    frames, nbytes = self._m_recv[topic]
+                    frames.inc()
+                    nbytes.inc(_FRAME.size + len(payload))
                 if topic == T_HELLO:
                     (n,) = struct.unpack_from("<q", payload, 0)
                     ids = struct.unpack_from(f"<{n}q", payload, 8)
@@ -467,6 +534,12 @@ class ServerBridge:
                     negotiated = (self.codec if peer == self.codec
                                   else CODEC_SPEC_NONE)
                     self._codec_of[conn] = negotiated
+                    # trace negotiation: ON iff the peer offered AND our
+                    # tracer is on (old peers send no flag -> off)
+                    trace_on = (_read_trace_flag(
+                        payload, 8 + 8 * n + _CODEC_TRAILER.size)
+                        and self._tracer.enabled)
+                    self._trace_of[conn] = trace_on
                     # T_CONFIG goes out BEFORE the ids are registered:
                     # once registered, the producer thread may race data
                     # rows onto this connection, and the worker-side
@@ -474,14 +547,16 @@ class ServerBridge:
                     # non-PING frame (per-connection FIFO).  Payload:
                     # PING cadence (0.0 = no heartbeats; the worker must
                     # not time out at all) + the run id + the negotiated
-                    # codec (old workers unpack_from past the trailer).
+                    # codec + the trace answer (old workers unpack_from
+                    # past both trailers).
                     self._send_raw(conn, T_CONFIG, 0,
                                    struct.pack("<dq",
                                                self._hb_interval or 0.0,
                                                self.run_id)
                                    + _CODEC_TRAILER.pack(
                                        negotiated.codec_id,
-                                       negotiated.param))
+                                       negotiated.param)
+                                   + _TRACE_TRAILER.pack(int(trace_on)))
                     with self._cv:
                         for w in ids:
                             self._conn_of[w] = conn
@@ -497,8 +572,24 @@ class ServerBridge:
                 elif topic == T_PONG:
                     pass            # liveness already stamped above
                 elif topic == T_GRADIENTS and self._fabric is not None:
-                    self._fabric.send(fabric_mod.GRADIENTS_TOPIC, 0,
-                                      serde.from_bytes(payload))
+                    fid = None
+                    if self._trace_of.get(conn):
+                        # strip the trace suffix BEFORE decoding —
+                        # compressed frames hand their whole tail to
+                        # unpack_parts, which must not see it
+                        (fid, _parent) = _TRACE_CTX.unpack_from(
+                            payload, len(payload) - _TRACE_CTX.size)
+                        payload = payload[:len(payload) - _TRACE_CTX.size]
+                    msg = serde.from_bytes(payload)
+                    if fid is not None:
+                        with self._tracer.span("net.recv",
+                                               topic="gradients"):
+                            self._tracer.flow_step("delta.wire", fid)
+                        # frozen dataclass: tests construct messages
+                        # positionally, so the context rides as a
+                        # dynamic attribute, not a schema field
+                        object.__setattr__(msg, "trace", fid)
+                    self._fabric.send(fabric_mod.GRADIENTS_TOPIC, 0, msg)
                 elif topic == T_PREDICT:
                     self._handle_predict(conn, key, payload)
         except (ConnectionError, OSError):
@@ -557,6 +648,7 @@ class ServerBridge:
             self._send_lock.pop(conn, None)
             self._last_recv.pop(conn, None)
             self._codec_of.pop(conn, None)
+            self._trace_of.pop(conn, None)
             self._cv.notify_all()
         if ids and not self._stop.is_set() and self.on_disconnect is not None:
             self.on_disconnect(ids)
@@ -571,7 +663,8 @@ class WorkerBridge:
     def __init__(self, host: str, port: int, worker_ids: list[int],
                  connect_timeout: float = 30.0,
                  heartbeat_timeout: float | None = None,
-                 codec: CodecSpec | None = None):
+                 codec: CodecSpec | None = None,
+                 tracer=None, telemetry=None):
         """`heartbeat_timeout`: seconds of total server silence before
         the connection is declared dead (only sensible when the server
         PINGs, i.e. it was built with a heartbeat_interval — otherwise a
@@ -579,11 +672,18 @@ class WorkerBridge:
         `codec`: this worker process's `--compress` choice, offered on
         HELLO; `self.negotiated` holds what the server agreed to (NONE
         against an old or differently-configured server) — the caller
-        builds its gradient compressors from THAT, not the flag."""
+        builds its gradient compressors from THAT, not the flag.
+        `tracer`: offering tracer — when it is on AND the server answers
+        the offer, `self.trace_negotiated` goes True and WEIGHTS /
+        GRADIENTS frames carry the 16-byte trace context."""
         self.worker_ids = list(worker_ids)
         self._heartbeat_timeout = heartbeat_timeout
         self.codec = codec if codec is not None else CODEC_SPEC_NONE
         self.negotiated = CODEC_SPEC_NONE
+        self._tracer = tracer or NULL_TRACER
+        self._telemetry = telemetry or NULL_TELEMETRY
+        self.trace_negotiated = False
+        self._m_sent, self._m_recv = _frame_counters(self._telemetry)
         self.wire_bytes: dict[int, int] = {}
         self._wire_lock = OrderedLock("WorkerBridge.wire")
         # retry: the server process may still be importing/binding when
@@ -606,7 +706,8 @@ class WorkerBridge:
         payload = (struct.pack(f"<q{len(self.worker_ids)}q",
                                len(self.worker_ids), *self.worker_ids)
                    + _CODEC_TRAILER.pack(self.codec.codec_id,
-                                         self.codec.param))
+                                         self.codec.param)
+                   + _TRACE_TRAILER.pack(int(self._tracer.enabled)))
         locked_send(self._sock, self._send_lock, T_HELLO, 0, payload)
         # synchronous handshake: the server replies T_CONFIG before it
         # registers our ids (net.ServerBridge._reader), so it is the
@@ -629,6 +730,10 @@ class WorkerBridge:
                     # a 16-byte CONFIG is an old server: no negotiation,
                     # stay uncompressed (the server can't decode tid 4/5)
                     self.negotiated = _read_codec_trailer(pl, 16)
+                    # trace answer sits after the codec trailer; an old
+                    # server never sends it -> tracing stays off-wire
+                    self.trace_negotiated = _read_trace_flag(
+                        pl, 16 + _CODEC_TRAILER.size)
                     break
                 raise ConnectionError(
                     f"expected T_CONFIG during handshake, got topic {topic}")
@@ -651,12 +756,26 @@ class WorkerBridge:
             def send(self, topic, key, message):
                 if topic == fabric_mod.GRADIENTS_TOPIC:
                     payload = serde.to_bytes(message)
+                    if bridge.trace_negotiated:
+                        # open the delta flow: this send slice is the
+                        # wire segment's source; the server's net.recv
+                        # is the first step of the arrow chain
+                        fid = bridge._tracer.new_flow_id()
+                        with bridge._tracer.span(
+                                "net.send", topic="gradients",
+                                worker=getattr(message, "worker_id", key)):
+                            bridge._tracer.flow_start("delta.wire", fid)
+                        payload += _TRACE_CTX.pack(fid, 0)
                     locked_send(bridge._sock, bridge._send_lock,
                                 T_GRADIENTS, key, payload)
                     with bridge._wire_lock:
                         bridge.wire_bytes[T_GRADIENTS] = (
                             bridge.wire_bytes.get(T_GRADIENTS, 0)
                             + _FRAME.size + len(payload))
+                    if bridge._telemetry.enabled:
+                        frames, nbytes = bridge._m_sent[T_GRADIENTS]
+                        frames.inc()
+                        nbytes.inc(_FRAME.size + len(payload))
                 else:
                     super().send(topic, key, message)
 
@@ -707,6 +826,10 @@ class WorkerBridge:
                     self.wire_bytes[topic] = (
                         self.wire_bytes.get(topic, 0)
                         + _FRAME.size + len(payload))
+                if self._telemetry.enabled:
+                    frames, nbytes = self._m_recv[topic]
+                    frames.inc()
+                    nbytes.inc(_FRAME.size + len(payload))
                 if topic == T_PING:
                     locked_send(self._sock, self._send_lock, T_PONG, 0)
                     continue
@@ -729,10 +852,22 @@ class WorkerBridge:
                         rows.append((row.features, row.label))
                     buffers[key].add_many(rows)
                     continue
+                fid = None
+                if topic == T_WEIGHTS and self.trace_negotiated:
+                    (fid, _parent) = _TRACE_CTX.unpack_from(
+                        payload, len(payload) - _TRACE_CTX.size)
+                    payload = payload[:len(payload) - _TRACE_CTX.size]
                 msg = serde.from_bytes(payload)
                 if topic == T_DATA:
                     buffers[key].add(msg.features, msg.label)
                 elif topic == T_WEIGHTS:
+                    if fid is not None:
+                        # close the weights flow on the receiving slice
+                        with self._tracer.span("net.recv",
+                                               topic="weights",
+                                               worker=key):
+                            self._tracer.flow_end("weights.wire", fid)
+                        object.__setattr__(msg, "trace", fid)
                     self.fabric.send(fabric_mod.WEIGHTS_TOPIC, key, msg)
         except (ConnectionError, OSError):
             pass

@@ -41,6 +41,8 @@ from kafka_ps_tpu.parallel.tracker import MessageTracker
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime.messages import (GangNotice, GradientMessage,
                                            KeyRange, WeightsMessage)
+from kafka_ps_tpu.telemetry import (CLOCK_BUCKETS, NULL_TELEMETRY,
+                                    model_name)
 from kafka_ps_tpu.utils import asynclog
 from kafka_ps_tpu.utils.config import EVENTUAL, PSConfig
 from kafka_ps_tpu.utils.trace import NULL_TRACER
@@ -55,11 +57,38 @@ class ServerNode:
                  test_x: np.ndarray | None = None,
                  test_y: np.ndarray | None = None,
                  log: LogSink | None = None,
-                 tracer=None):
+                 tracer=None, telemetry=None):
         self.tracer = tracer or NULL_TRACER
+        self.telemetry = telemetry or NULL_TELEMETRY
         self.cfg = cfg
         self.fabric = fabric
         self.tracker = MessageTracker(cfg.num_workers)
+        # consistency-model observability (docs/OBSERVABILITY.md): the
+        # gate-wait and clock-lag distributions are what distinguish BSP
+        # from bounded-delay from async at runtime.  Metric children are
+        # pre-resolved here so the hot path never touches the registry's
+        # family lock (null metrics when telemetry is off).
+        model = model_name(cfg.consistency_model)
+        self._m_gate_wait = self.telemetry.histogram(
+            "gate_wait_ms", model=model)
+        self._m_clock_lag = self.telemetry.histogram(
+            "clock_lag", buckets=CLOCK_BUCKETS, model=model)
+        self._m_worker_lag = [
+            self.telemetry.gauge("worker_clock_lag", worker=str(w))
+            for w in range(cfg.num_workers)]
+        self._m_grads = [
+            self.telemetry.counter("gradients_applied_total", worker=str(w))
+            for w in range(cfg.num_workers)]
+        self._m_snapshots = self.telemetry.counter(
+            "snapshots_published_total")
+        self._m_serving_clock = self.telemetry.gauge("serving_clock")
+        # perf_counter stamp of each worker's last un-answered gradient:
+        # gate wait = release time - arrival time (host scalars only)
+        self._grad_arrived: dict[int, float] = {}
+        # trace context of the gradient currently being processed — the
+        # snapshot published by its release inherits it, extending the
+        # delta.wire flow into the serving plane
+        self._pending_trace = None
         from kafka_ps_tpu.models.task import get_task
         self.task = get_task(cfg.task, cfg.model)
         # device-resident; updated by replacement only (see module doc)
@@ -212,6 +241,19 @@ class ServerNode:
                          self._weights_message(clock))
         self.weights_sent_at[worker] = time.monotonic()
         self.tracker.sent_message(worker, clock)
+        self._observe_gate_release(worker)
+
+    def _observe_gate_release(self, worker: int) -> None:
+        """Gate-wait sample: how long this worker's gradient sat at the
+        gate before its reply went out (BSP waits for the round, bounded
+        delay waits for the slowest-within-k, eventual ~0).  Bootstrap
+        and readmission sends have no arrival stamp and record
+        nothing."""
+        if not self.telemetry.enabled:
+            return
+        arrived = self._grad_arrived.pop(worker, None)
+        if arrived is not None:
+            self._m_gate_wait.observe((time.perf_counter() - arrived) * 1e3)
 
     # -- consistency gate (ServerProcessor.java:95-134) --------------------
 
@@ -316,18 +358,27 @@ class ServerNode:
             return 0
         return min(self.tracker.tracker[w].vector_clock for w in active)
 
-    def publish_snapshot(self, theta=None, clock=None) -> None:
+    def publish_snapshot(self, theta=None, clock=None, trace=None) -> None:
         """Publish (theta, stable clock) to the attached snapshot
         registry; no-op when serving is off.  Called at every gate
         release — per-message, gang, fused — plus bootstrap/cold-start.
         O(1) host-side (the snapshot aliases the immutable device
-        theta), so attaching a registry cannot perturb training."""
+        theta), so attaching a registry cannot perturb training.
+        `trace` (default: the context of the gradient being processed)
+        rides on the snapshot so the serving plane can close the
+        delta.wire flow at first read."""
         registry = self.serving
         if registry is None:
             return
+        if trace is None:
+            trace = self._pending_trace
+        clock = self.serving_clock() if clock is None else clock
         registry.publish(self.theta if theta is None else theta,
-                         self.serving_clock() if clock is None else clock)
+                         clock, trace=trace)
         self.tracer.count("serving.snapshots_published")
+        if self.telemetry.enabled:
+            self._m_snapshots.inc()
+            self._m_serving_clock.set(clock)
 
     # -- the hot path (ServerProcessor.java:143-183) -----------------------
 
@@ -348,6 +399,10 @@ class ServerNode:
             return
         self.tracker.received_message(msg.worker_id, msg.vector_clock)
         self.tracer.count("server.gradients_applied")
+        if self.telemetry.enabled:
+            self._observe_arrival(msg.worker_id, msg.vector_clock)
+        fid = getattr(msg, "trace", None)
+        self._pending_trace = fid
 
         want_eval = (msg.worker_id == 0 and self.test_x is not None
                      and msg.vector_clock % self.cfg.eval_every == 0)
@@ -370,6 +425,11 @@ class ServerNode:
                     self.theta = self._apply_full(jnp.asarray(self.theta),
                                                   msg.values)
                 self.tracer.count("dispatch.device")
+                if fid is not None:
+                    # step the delta flow: the wire arrow lands on the
+                    # net.recv slice, this one on the apply slice
+                    self.tracer.flow_step("delta.wire", fid,
+                                          clock=msg.vector_clock)
             else:
                 # pscheck: disable=PS102 (KeyRange splice is the documented host path)
                 host = np.array(self.theta)
@@ -395,8 +455,25 @@ class ServerNode:
 
         self.dispatch_release_set(
             self.workers_to_respond_to(msg.vector_clock, msg.worker_id))
+        self._pending_trace = None
 
         self.maybe_checkpoint()
+
+    def _observe_arrival(self, worker: int, clock: int) -> None:
+        """Per-gradient consistency observations, all host integers:
+        arrival stamp (gate-wait baseline), this worker's clock lag
+        behind the fastest active worker, and the applied-count."""
+        self._grad_arrived[worker] = time.perf_counter()
+        self._m_grads[worker].inc()
+        active = self.tracker.active_workers
+        if active:
+            fastest = max(self.tracker.tracker[w].vector_clock
+                          for w in active)
+            for w in active:
+                lag = fastest - self.tracker.tracker[w].vector_clock
+                self._m_worker_lag[w].set(lag)
+            self._m_clock_lag.observe(
+                fastest - self.tracker.tracker[worker].vector_clock)
 
     def process_batch(self, msgs: list[GradientMessage]) -> None:
         """Apply several queued gradients as ONE chained jit dispatch
@@ -460,6 +537,8 @@ class ServerNode:
         for i, m in enumerate(live):
             self.tracker.received_message(m.worker_id, m.vector_clock)
             self.tracer.count("server.gradients_applied")
+            if self.telemetry.enabled:
+                self._observe_arrival(m.worker_id, m.vector_clock)
             if (m.worker_id == 0 and self.test_x is not None
                     and m.vector_clock % self.cfg.eval_every == 0):
                 eval_positions.append(i)
@@ -489,6 +568,11 @@ class ServerNode:
                 jnp.asarray(self.theta), self.test_x, self.test_y,
                 *[m.values for m in live])
             self.iterations += k
+            for m in live:
+                fid = getattr(m, "trace", None)
+                if fid is not None:
+                    self.tracer.flow_step("delta.wire", fid,
+                                          clock=m.vector_clock)
         self.tracer.count("dispatch.device")
         self.tracer.count("server.gang_batched_applies")
         self.theta = final_theta
@@ -521,7 +605,8 @@ class ServerNode:
                     # release observed, at the clock captured when the
                     # gate opened — one snapshot per release event, same
                     # as the per-message path
-                    self.publish_snapshot(theta_i, snap_clocks[i])
+                    self.publish_snapshot(theta_i, snap_clocks[i],
+                                          trace=getattr(m, "trace", None))
         # ONE notice for everything this batch released: the release
         # events are simultaneous from the drive loop's point of view
         # (all sends above happened before any worker ran), and the gang
@@ -578,6 +663,7 @@ class ServerNode:
                            key_range=KeyRange(0, self.task.num_params),
                            values=theta, encoded=encoded))
         self.weights_sent_at[worker] = time.monotonic()
+        self._observe_gate_release(worker)
 
     def maybe_checkpoint(self) -> None:
         """Save once every `checkpoint_every` applied iterations —

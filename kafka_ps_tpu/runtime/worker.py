@@ -34,6 +34,7 @@ from kafka_ps_tpu.compress import slab as slab_mod
 from kafka_ps_tpu.data.buffer import SlidingBuffer
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime.messages import GradientMessage, KeyRange, WeightsMessage
+from kafka_ps_tpu.telemetry import NULL_TELEMETRY
 from kafka_ps_tpu.utils import asynclog
 from kafka_ps_tpu.utils.config import PSConfig
 from kafka_ps_tpu.utils.trace import NULL_TRACER
@@ -82,8 +83,15 @@ class WorkerNode:
                  test_x: np.ndarray | None = None,
                  test_y: np.ndarray | None = None,
                  log: LogSink | None = None,
-                 tracer=None):
+                 tracer=None, telemetry=None):
         self.tracer = tracer or NULL_TRACER
+        self.telemetry = telemetry or NULL_TELEMETRY
+        # pre-resolved children: one leaf-lock inc / observe per
+        # iteration when telemetry is on, nothing when off
+        self._m_updates = self.telemetry.counter(
+            "worker_updates_total", worker=str(worker_id))
+        self._m_update_ms = self.telemetry.histogram(
+            "worker_update_ms", worker=str(worker_id))
         self.worker_id = worker_id
         self.cfg = cfg
         self.fabric = fabric
@@ -106,7 +114,8 @@ class WorkerNode:
         # re-upload remains the bootstrap/restore/mass-churn fallback.
         self._slab_version: int | None = None
         self._slab_store = slab_mod.SlabStore(
-            cfg.slab_dtype, buffer.cfg.max_size, buffer.num_features)
+            cfg.slab_dtype, buffer.cfg.max_size, buffer.num_features,
+            telemetry=self.telemetry)
         self.iterations = 0
         # iterations counted at (re)admission: the supervisor grants the
         # jit-compile grace to the first iteration *since joining*, not
@@ -208,6 +217,8 @@ class WorkerNode:
         self.fabric.send(fabric_mod.GRADIENTS_TOPIC, 0, out)
         if self.compressor is not None:
             self._last_sent = (msg.vector_clock, out)
+        if self.telemetry.enabled:
+            self._m_updates.inc()
         self.last_progress = time.monotonic()
 
     def _redelivered_weights(self, msg: WeightsMessage) -> bool:
@@ -247,6 +258,7 @@ class WorkerNode:
         update_fn, update_eval_fn = _solver_fns(
             self.cfg.task, self.cfg.model, self.cfg.use_pallas)
         f1, acc = -1.0, -1.0
+        t0 = time.perf_counter()
         with self.tracer.span("worker.local_update", worker=self.worker_id,
                               clock=msg.vector_clock):
             if want_eval:
@@ -255,5 +267,9 @@ class WorkerNode:
             else:
                 delta, loss = update_fn(theta, x, y, mask)
         self.tracer.count("dispatch.device")
+        if self.telemetry.enabled:
+            # dispatch wall time, host clocks only — the async dispatch
+            # is NOT synced for this (bitwise/latency non-perturbing)
+            self._m_update_ms.observe((time.perf_counter() - t0) * 1e3)
 
         self._finish(msg, seen, delta, loss, f1, acc)

@@ -29,6 +29,7 @@ import numpy as np
 
 from kafka_ps_tpu.serving import policy
 from kafka_ps_tpu.serving.snapshot import SnapshotRegistry
+from kafka_ps_tpu.telemetry import NULL_TELEMETRY
 from kafka_ps_tpu.utils.trace import NULL_TRACER, LatencyRecorder
 
 
@@ -54,12 +55,22 @@ class PredictionEngine:
 
     def __init__(self, task, registry: SnapshotRegistry, *,
                  max_batch: int = 16, deadline_s: float = 0.002,
-                 tracer=None, now=time.time):
+                 tracer=None, telemetry=None, now=time.time):
         self.task = task
         self.registry = registry
         self.max_batch = max(1, int(max_batch))
         self.deadline_s = max(0.0, float(deadline_s))
         self.tracer = tracer or NULL_TRACER
+        self.telemetry = telemetry or NULL_TELEMETRY
+        # pre-resolved metric children (null when telemetry is off):
+        # observed per micro-batch, never per row, never on device data
+        self._m_snapshot_age = self.telemetry.histogram("snapshot_age_ms")
+        self._m_requests = self.telemetry.counter("serving_requests_total")
+        self._m_rejections = self.telemetry.counter(
+            "serving_rejections_total")
+        # seq of the last snapshot whose delta.wire flow was closed here:
+        # the flow ends once, at the snapshot's FIRST serving read
+        self._last_traced_seq = -1
         self._now = now
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self.latency = LatencyRecorder()
@@ -139,6 +150,13 @@ class PredictionEngine:
         # from the same hot-swapped (theta, clock) pair
         snap = self.registry.latest
         now = self._now()
+        if self.telemetry.enabled:
+            self._m_requests.inc(len(batch))
+            if snap is not None:
+                # read-side staleness: how old the answering snapshot is
+                # at serve time (host floats; one sample per micro-batch)
+                self._m_snapshot_age.observe(
+                    max(0.0, (now - snap.wall_time) * 1e3))
         live: list[_Request] = []
         for req in batch:
             try:
@@ -146,13 +164,15 @@ class PredictionEngine:
             except policy.StalenessError as err:
                 self.rejections += 1
                 self.tracer.count("serving.staleness_rejections")
+                if self.telemetry.enabled:
+                    self._m_rejections.inc()
                 self._finish(req, err)
                 continue
             live.append(req)
         if not live:
             return
         try:
-            labels, confs = self._dispatch(snap.theta, live)
+            labels, confs = self._dispatch(snap, live)
         except Exception as err:  # noqa: BLE001 — fail the rows, not the loop
             self.errors += 1
             for req in live:
@@ -166,14 +186,21 @@ class PredictionEngine:
             self._finish(req, Prediction(int(labels[i]), float(confs[i]),
                                          snap.vector_clock, snap.wall_time))
 
-    def _dispatch(self, theta, live: list[_Request]):
+    def _dispatch(self, snap, live: list[_Request]):
         fn = self._predict_fn()
         xs = np.zeros((self.max_batch, self.task.cfg.num_features),
                       dtype=np.float32)
         for i, req in enumerate(live):
             xs[i, :req.x.size] = req.x[:xs.shape[1]]
         with self.tracer.span("serving.predict", rows=len(live)):
-            labels, confs = fn(theta, xs)
+            if snap.trace is not None and snap.seq > self._last_traced_seq:
+                # close the delta.wire flow on this snapshot's FIRST
+                # serving read: buffer -> solve -> wire -> apply ->
+                # publish -> here, one connected arrow chain in Perfetto
+                self._last_traced_seq = snap.seq
+                self.tracer.flow_end("delta.wire", snap.trace,
+                                     clock=snap.vector_clock)
+            labels, confs = fn(snap.theta, xs)
             # block so latency samples measure real service time
             labels = np.asarray(labels)  # pscheck: disable=PS102 (deliberate latency-sample sync)
             confs = np.asarray(confs)  # pscheck: disable=PS102 (deliberate latency-sample sync)

@@ -79,17 +79,25 @@ def bounded(max_age_s: float) -> ReadBound:
     return ReadBound(max_age_s=max_age_s)
 
 
-def check(snapshot, bound: ReadBound | None, now: float) -> None:
+def check(snapshot, bound: ReadBound | None, now: float,
+          telemetry=None) -> None:
     """Raise StalenessError unless `snapshot` satisfies `bound`.
 
     `snapshot` is a serving.snapshot.Snapshot or None (nothing published
     yet — every bound, including the empty one, rejects that).
+    `telemetry` (a kafka_ps_tpu.telemetry.Telemetry, optional to keep
+    this module dependency-free for thin clients) records the observed
+    snapshot age so BSP/bounded/async read-staleness distributions are
+    benchable — host floats only, never touching snapshot.theta.
     """
     if snapshot is None:
         raise StalenessError(
             "no snapshot published yet",
             min_clock=None if bound is None else bound.min_clock,
             max_age_s=None if bound is None else bound.max_age_s)
+    if telemetry is not None and telemetry.enabled:
+        telemetry.histogram("snapshot_age_ms").observe(
+            max(0.0, (now - snapshot.wall_time) * 1e3))
     b = bound or EVENTUAL_READ
     if b.min_clock is not None and snapshot.vector_clock < b.min_clock:
         raise StalenessError(

@@ -32,6 +32,10 @@ class Snapshot(NamedTuple):
     vector_clock: int      # stable clock: min active-worker clock at publish
     wall_time: float       # publication time (registry's clock)
     seq: int               # monotonically increasing publication number
+    # trace context of the gradient whose gate release published this
+    # snapshot (docs/OBSERVABILITY.md); None when tracing is off —
+    # defaulted so existing 4-positional constructions stay valid
+    trace: object = None
 
 
 class SnapshotRegistry:
@@ -46,13 +50,14 @@ class SnapshotRegistry:
         self._publish_lock = OrderedLock("SnapshotRegistry.publish")
 
     def publish(self, theta, vector_clock: int,
-                wall_time: float | None = None) -> Snapshot:
+                wall_time: float | None = None,
+                trace=None) -> Snapshot:
         with self._publish_lock:
             self._seq += 1
             snap = Snapshot(
                 theta, int(vector_clock),
                 self._now() if wall_time is None else float(wall_time),
-                self._seq)
+                self._seq, trace)
             self._ring.append(snap)
             # single atomic reference swap — this is the hot-swap point;
             # readers of `latest` never block on the publish lock

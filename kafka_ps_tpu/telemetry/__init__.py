@@ -1,0 +1,14 @@
+"""End-to-end telemetry plane (docs/OBSERVABILITY.md): metrics
+registry + cross-process causal tracing glue over the utils/trace.py
+and utils/status.py backends."""
+
+from kafka_ps_tpu.telemetry.registry import (CLOCK_BUCKETS,
+                                             LATENCY_BUCKETS_MS,
+                                             NULL_TELEMETRY, Counter,
+                                             Gauge, Histogram,
+                                             MetricsRegistry, Telemetry,
+                                             maybe_telemetry, model_name)
+
+__all__ = ["CLOCK_BUCKETS", "LATENCY_BUCKETS_MS", "NULL_TELEMETRY",
+           "Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "Telemetry", "maybe_telemetry", "model_name"]

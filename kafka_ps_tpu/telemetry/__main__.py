@@ -1,0 +1,41 @@
+"""`python -m kafka_ps_tpu.telemetry` — telemetry CLI.
+
+Subcommands:
+  merge -o OUT in1.json in2.json ...
+      Stitch per-process --trace files from a socket-mode run into one
+      Chrome/Perfetto trace (docs/OBSERVABILITY.md walkthrough).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from kafka_ps_tpu.telemetry.merge import merge_traces
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="kafka_ps_tpu.telemetry")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    merge = sub.add_parser(
+        "merge", help="stitch per-process trace files into one timeline")
+    merge.add_argument("-o", "--out", required=True,
+                       help="merged Chrome trace output path")
+    merge.add_argument("inputs", nargs="+",
+                       help="per-process trace files (Tracer.dump output)")
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "merge":
+        stats = merge_traces(args.inputs, args.out)
+        print(f"merged {stats['files']} files / {stats['events']} events "
+              f"-> {args.out} (pids {stats['pids']}, "
+              f"{stats['cross_process_flows']} cross-process flows)")
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,10 +4,13 @@ Control Center, BaseKafkaApp.java:73-78, dev/docker-compose.yaml:30-47)
 rebuilt for the TPU runtime.
 
 Three layers:
-  * `Tracer` — host-side span + counter recorder.  Spans export as
-    Chrome trace-event JSON (load in chrome://tracing or Perfetto);
-    counters give the message-flow view the Kafka interceptors provided
-    (sends per topic, iterations per worker).
+  * `Tracer` — host-side span + counter + flow-event recorder.  Spans
+    export as Chrome trace-event JSON (load in chrome://tracing or
+    Perfetto); counters are sampled over time as `ph: "C"` counter
+    events, giving the per-topic message-flow timeline the Kafka
+    interceptors provided; flow events (`ph: s/t/f`) connect a delta's
+    lifecycle across threads AND processes (the wire trace context,
+    runtime/net.py + docs/OBSERVABILITY.md).
   * `Tracer.span(...)` context manager — wrap any section; thread-safe,
     so the threaded runtime's per-worker threads can share one tracer.
   * `device_trace(...)` — jax.profiler wrapper capturing XLA/TPU traces
@@ -21,20 +24,37 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import threading
 import time
 from collections import defaultdict, deque
 
 
 class Tracer:
-    """Span + counter recorder with Chrome trace-event export."""
+    """Span + counter + flow recorder with Chrome trace-event export.
 
-    def __init__(self, clock=time.perf_counter):
+    `pid` labels every event (defaults to the real process id — the
+    merge CLI in kafka_ps_tpu/telemetry keys track groups off it);
+    `counter_sample_s` throttles how often a hot counter emits a
+    timeline sample (0 = every increment, for deterministic tests)."""
+
+    def __init__(self, clock=time.perf_counter, pid: int | None = None,
+                 counter_sample_s: float = 0.01):
         self._clock = clock
         self._t0 = clock()
+        # wall-clock anchor for cross-process merging: perf_counter
+        # epochs are process-private, so dump() records where this
+        # tracer's zero sits on the shared wall clock
+        self._wall0 = time.time()
         self._lock = threading.Lock()
         self._events: list[dict] = []
         self._counters: dict[str, int] = defaultdict(int)
+        # sampled (ts_us, name, total) points -> ph:"C" events at dump
+        self._counter_samples: list[tuple[float, str, int]] = []
+        self._sample_every = counter_sample_s
+        self._last_sample: dict[str, float] = {}
+        self._flow_seq = 0
+        self.pid = os.getpid() if pid is None else pid
         self.enabled = True
 
     # -- spans -------------------------------------------------------------
@@ -54,7 +74,7 @@ class Tracer:
                     "ph": "X",                      # complete event
                     "ts": (start - self._t0) * 1e6,  # µs, trace convention
                     "dur": (end - start) * 1e6,
-                    "pid": 0,
+                    "pid": self.pid,
                     "tid": threading.get_ident() % 2 ** 31,
                     "args": args,
                 })
@@ -63,8 +83,48 @@ class Tracer:
     def count(self, name: str, n: int = 1) -> None:
         if not self.enabled:
             return
+        now = self._clock()
         with self._lock:
             self._counters[name] += n
+            # throttled timeline sample: Perfetto renders these as a
+            # counter track (the satellite fix — totals alone never
+            # appeared on the timeline)
+            if now - self._last_sample.get(name, -1e18) >= self._sample_every:
+                self._last_sample[name] = now
+                self._counter_samples.append(
+                    ((now - self._t0) * 1e6, name, self._counters[name]))
+
+    # -- flow events (cross-thread / cross-process causality) --------------
+    def new_flow_id(self) -> int:
+        """Globally-unique flow id: pid in the high bits so ids from
+        different processes never collide in a merged trace."""
+        with self._lock:
+            self._flow_seq += 1
+            return ((self.pid & 0xFFFF) << 40) | self._flow_seq
+
+    def flow(self, ph: str, name: str, flow_id: int, **args) -> None:
+        """One flow event: ph 's' (start), 't' (step), 'f' (end).
+        Emit from inside a span — viewers bind the arrow endpoints to
+        the enclosing slice on this (pid, tid)."""
+        if not self.enabled:
+            return
+        now = self._clock()
+        ev = {"name": name, "cat": "flow", "ph": ph, "id": flow_id,
+              "ts": (now - self._t0) * 1e6, "pid": self.pid,
+              "tid": threading.get_ident() % 2 ** 31, "args": args}
+        if ph == "f":
+            ev["bp"] = "e"      # bind the arrowhead to the enclosing slice
+        with self._lock:
+            self._events.append(ev)
+
+    def flow_start(self, name: str, flow_id: int, **args) -> None:
+        self.flow("s", name, flow_id, **args)
+
+    def flow_step(self, name: str, flow_id: int, **args) -> None:
+        self.flow("t", name, flow_id, **args)
+
+    def flow_end(self, name: str, flow_id: int, **args) -> None:
+        self.flow("f", name, flow_id, **args)
 
     # -- export ------------------------------------------------------------
     def counters(self) -> dict[str, int]:
@@ -82,10 +142,30 @@ class Tracer:
                 for name, ds in sorted(acc.items())}
 
     def dump(self, path: str) -> str:
-        """Chrome trace-event JSON: {traceEvents: [...], counters: ...}."""
+        """Chrome trace-event JSON: {traceEvents: [...], counters: ...}.
+
+        Counters land on the timeline as standard `ph: "C"` counter
+        events (one per throttled sample plus a closing sample at dump
+        time), so Perfetto draws them as counter tracks; the top-level
+        "counters" totals stay for the programmatic consumers
+        (span_stats callers, tests).  "wallClockT0" anchors this
+        process's ts=0 on the shared wall clock for the merge CLI."""
+        now_us = (self._clock() - self._t0) * 1e6
         with self._lock:
-            payload = {"traceEvents": list(self._events),
-                       "counters": dict(self._counters)}
+            events = list(self._events)
+            tid = threading.get_ident() % 2 ** 31
+            for ts_us, name, total in self._counter_samples:
+                events.append({"name": name, "ph": "C", "ts": ts_us,
+                               "pid": self.pid, "tid": tid,
+                               "args": {"value": total}})
+            for name, total in sorted(self._counters.items()):
+                events.append({"name": name, "ph": "C", "ts": now_us,
+                               "pid": self.pid, "tid": tid,
+                               "args": {"value": total}})
+            payload = {"traceEvents": events,
+                       "counters": dict(self._counters),
+                       "wallClockT0": self._wall0,
+                       "pid": self.pid}
         with open(path, "w") as f:
             json.dump(payload, f)
         return path

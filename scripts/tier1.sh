@@ -30,6 +30,13 @@
 # (docs/ANALYSIS.md) over the package — fails on ANY unsuppressed
 # finding — plus ruff (pyproject.toml, rule sets E/F/B/PLE) when the
 # binary is installed.
+#
+# `scripts/tier1.sh --obs` runs the observability smoke leg: a short
+# socket-bridged run with tracing and metrics on (two tracers with
+# distinct pids standing in for the `--listen --trace` / `--connect
+# --trace` processes), asserting the merged trace contains >= 1
+# cross-process flow and the Prometheus dump parses with the staleness
+# histogram families populated (docs/OBSERVABILITY.md).
 set -o pipefail
 
 if [[ "${1:-}" == "--analyze" ]]; then
@@ -41,6 +48,111 @@ if [[ "${1:-}" == "--analyze" ]]; then
     fi
     echo ANALYZE_OK
     exit 0
+fi
+
+if [[ "${1:-}" == "--obs" ]]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
+import tempfile
+import threading
+from pathlib import Path
+
+from kafka_ps_tpu.data.buffer import SlidingBuffer
+from kafka_ps_tpu.data.synth import generate_hard
+from kafka_ps_tpu.runtime import fabric as fabric_mod, net
+from kafka_ps_tpu.runtime.server import ServerNode
+from kafka_ps_tpu.runtime.worker import WorkerNode
+from kafka_ps_tpu.telemetry import Telemetry
+from kafka_ps_tpu.telemetry.merge import merge_traces
+from kafka_ps_tpu.utils.config import BufferConfig, ModelConfig, PSConfig
+from kafka_ps_tpu.utils.csvlog import NullLogSink
+from kafka_ps_tpu.utils.trace import Tracer
+
+model = ModelConfig(num_features=64, num_classes=2)
+x, y = generate_hard(512 + 500, num_features=64, num_classes=2, seed=9)
+test_x, test_y = x[-500:], y[-500:]
+ids = [0, 1]
+cfg = PSConfig(num_workers=2, consistency_model=2, model=model,
+               buffer=BufferConfig(min_size=32, max_size=256),
+               eval_every=10**9, use_gang=False)
+# two tracers with distinct pids stand in for the two PROCESSES the
+# socket deployment runs (`--listen --trace` / `--connect --trace`)
+tr_s, tr_w = Tracer(pid=1001), Tracer(pid=2002)
+tel_s, tel_w = Telemetry(tracer=tr_s), Telemetry(tracer=tr_w)
+sbridge = net.ServerBridge(port=0, run_id=1, tracer=tr_s, telemetry=tel_s)
+sfabric = sbridge.wrap(fabric_mod.Fabric())
+server = ServerNode(cfg, sfabric, test_x, test_y, NullLogSink(),
+                    tracer=tr_s, telemetry=tel_s)
+wbridge = net.WorkerBridge("127.0.0.1", sbridge.port, ids,
+                           tracer=tr_w, telemetry=tel_w)
+assert wbridge.trace_negotiated, "trace context did not negotiate on"
+wfabric = wbridge.make_fabric()
+buffers = {w: SlidingBuffer(64, cfg.buffer, telemetry=tel_w, worker=w)
+           for w in ids}
+nodes = {w: WorkerNode(w, cfg, wfabric, buffers[w], test_x, test_y,
+                       NullLogSink(), tracer=tr_w, telemetry=tel_w)
+         for w in ids}
+for w in ids:
+    for i in range(w, 512, 2):
+        buffers[w].add(dict(enumerate(x[i])), int(y[i]))
+reader = threading.Thread(target=wbridge.run_reader, args=(buffers,),
+                          daemon=True)
+reader.start()
+for w in ids:
+    wbridge.mark_ready(w)
+sbridge.wait_for_connected(ids, timeout=30)
+sbridge.wait_for_workers(ids, timeout=30)
+stop = threading.Event()
+def worker_loop(node):
+    try:
+        while not stop.is_set():
+            m = wfabric.poll_blocking(fabric_mod.WEIGHTS_TOPIC,
+                                      node.worker_id, timeout=0.05)
+            if m is not None:
+                node.on_weights(m)
+    except (ConnectionError, OSError):
+        pass
+ts = [threading.Thread(target=worker_loop, args=(nodes[w],), daemon=True)
+      for w in ids]
+for t in ts:
+    t.start()
+server.start_training_loop()
+while server.iterations < 24:
+    g = sfabric.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0, timeout=0.2)
+    if g is not None:
+        server.process(g)
+stop.set()
+sbridge.close()
+for t in ts:
+    t.join(timeout=120)
+wbridge.close()
+reader.join(timeout=10)
+server.log.close()
+
+out = Path(tempfile.mkdtemp(prefix="kps-obs-"))
+pw, ps = str(out / "worker.trace.json"), str(out / "server.trace.json")
+tr_w.dump(pw)
+tr_s.dump(ps)
+stats = merge_traces([pw, ps], str(out / "merged.json"))
+assert stats["cross_process_flows"] >= 1, stats
+assert sorted(stats["pids"]) == [1001, 2002], stats
+
+metrics = str(out / "metrics.prom")
+tel_s.write_prometheus(metrics)
+text = Path(metrics).read_text()
+for line in text.splitlines():          # every sample line must parse
+    if line and not line.startswith("#"):
+        float(line.rsplit(" ", 1)[1])
+for family in ("gate_wait_ms_bucket", "clock_lag_bucket",
+               "gradients_applied_total", "frames_received"):
+    assert family in text, f"{family} missing from metrics dump"
+assert 'model="bounded"' in text, "staleness histograms unlabeled"
+snap = tel_s.snapshot()
+assert snap["gate_wait_ms"]["model=bounded"]["count"] > 0, snap
+print(f"OBS_SMOKE_OK flows={stats['cross_process_flows']} "
+      f"events={stats['events']} pids={sorted(stats['pids'])} "
+      f"metric_families={len(snap)}")
+EOF
+    exit $?
 fi
 
 if [[ "${1:-}" == "--compress" ]]; then

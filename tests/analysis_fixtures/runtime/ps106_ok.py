@@ -1,0 +1,13 @@
+"""PS106 negative: telemetry calls handed host scalars only (perf
+counter deltas, ints, .nbytes) — nothing inside the call arguments can
+touch the device."""
+
+import time
+
+
+def record_step(hist, counter, tracer, t0, payload):
+    hist.observe((time.perf_counter() - t0) * 1e3)
+    counter.inc(payload.nbytes)
+    tracer.count("frames.sent", 1)
+    with tracer.span("net.send", topic="gradients", size=len(payload)):
+        pass

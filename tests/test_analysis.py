@@ -30,6 +30,7 @@ def _findings(relpath: str):
     ("ps103/serde.py", "PS103"),
     ("log/ps104_bad.py", "PS104"),
     ("ps105_bad.py", "PS105"),
+    ("runtime/ps106_bad.py", "PS106"),
 ])
 def test_positive_fixture_triggers_exactly_once(relpath, rule):
     found = _findings(relpath)
@@ -43,6 +44,7 @@ def test_positive_fixture_triggers_exactly_once(relpath, rule):
     "ps103/net.py",
     "log/ps104_ok.py",
     "ps105_ok.py",
+    "runtime/ps106_ok.py",
 ])
 def test_negative_fixture_stays_clean(relpath):
     assert _findings(relpath) == []

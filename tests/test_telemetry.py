@@ -1,0 +1,310 @@
+"""Telemetry plane (kafka_ps_tpu/telemetry/ + the wire trace context in
+runtime/net.py): registry thread-safety, histogram bucket semantics,
+cross-process trace-context negotiation + propagation, the merge CLI,
+and the bitwise telemetry-off/on training contract."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime import net
+from kafka_ps_tpu.runtime.messages import (GradientMessage, KeyRange,
+                                           WeightsMessage)
+from kafka_ps_tpu.telemetry import (CLOCK_BUCKETS, Histogram,
+                                    MetricsRegistry, NULL_TELEMETRY,
+                                    Telemetry, maybe_telemetry, model_name)
+from kafka_ps_tpu.telemetry.merge import merge_traces
+from kafka_ps_tpu.utils.trace import Tracer
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_registry_thread_safety_under_concurrent_writers():
+    reg = MetricsRegistry()
+    WRITERS, PER = 8, 500
+
+    def writer(i):
+        # half the threads share one child, half create per-thread ones:
+        # both the family lock (child creation) and the leaf lock
+        # (mutation) are exercised concurrently
+        shared = reg.counter("frames_sent", topic="gradients")
+        own = reg.counter("frames_sent", topic=f"w{i % 4}")
+        hist = reg.histogram("gate_wait_ms", model="bounded")
+        g = reg.gauge("worker_clock_lag", worker=str(i % 2))
+        for k in range(PER):
+            shared.inc()
+            own.inc(2)
+            hist.observe(float(k % 7))
+            g.set(k)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(WRITERS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    snap = reg.snapshot()
+    fam = snap["frames_sent"]
+    assert fam["topic=gradients"] == WRITERS * PER
+    per_topic = sum(fam[f"topic=w{i}"] for i in range(4))
+    assert per_topic == WRITERS * PER * 2
+    assert snap["gate_wait_ms"]["model=bounded"]["count"] == WRITERS * PER
+    # prometheus text parses as one line per sample, no torn state
+    text = reg.prometheus_text()
+    assert 'frames_sent{topic="gradients"}' in text
+    assert "gate_wait_ms_bucket" in text
+
+
+def test_histogram_bucket_edges_are_inclusive_upper():
+    h = Histogram(bounds=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0):      # both land in the first bucket (le=1)
+        h.observe(v)
+    h.observe(1.0001)         # second bucket (le=2)
+    h.observe(4.0)            # third bucket (le=4), inclusive edge
+    h.observe(100.0)          # +Inf overflow
+    counts, total_sum, n = h.state()
+    assert counts == [2, 1, 1, 1]
+    assert n == 5 and total_sum == pytest.approx(0.5 + 1 + 1.0001 + 4 + 100)
+    # rank 2.5 of 5 lands in the second bucket (bucket-edge estimate)
+    assert h.quantile(0.5) == 2.0
+    # the overflow bucket reports the largest finite edge
+    assert h.quantile(1.0) == 4.0
+
+
+def test_clock_buckets_give_bsp_lag_zero_its_own_bucket():
+    h = Histogram(bounds=CLOCK_BUCKETS)
+    for _ in range(10):
+        h.observe(0)
+    counts, _, _ = h.state()
+    assert counts[0] == 10 and sum(counts[1:]) == 0
+    assert model_name(0) == "sequential"
+    assert model_name(3) == "bounded"
+    assert model_name(-1) == "eventual"
+
+
+def test_maybe_telemetry_gates_on_inputs():
+    assert maybe_telemetry(None, want_metrics=False) is NULL_TELEMETRY
+    assert not NULL_TELEMETRY.enabled
+    t = maybe_telemetry(None, want_metrics=True)
+    assert t.enabled and isinstance(t, Telemetry)
+
+
+# -- wire trace context (runtime/net.py) ------------------------------------
+
+def _grad(worker_id, clock, n=4):
+    return GradientMessage(vector_clock=clock, key_range=KeyRange(0, n),
+                           values=np.arange(n, dtype=np.float32),
+                           worker_id=worker_id)
+
+
+def _weights(clock, n=4):
+    return WeightsMessage(vector_clock=clock, key_range=KeyRange(0, n),
+                          values=np.ones(n, dtype=np.float32))
+
+
+def test_wire_trace_context_propagates_and_legacy_peer_negotiates_off():
+    """One traced server, one traced worker (negotiates ON, flow ids
+    cross the wire) and one legacy worker with no tracer (negotiates
+    OFF, byte-identical legacy frames, msg.trace stays None)."""
+    tr_server = Tracer(pid=11)
+    tr_worker = Tracer(pid=22)
+    bridge = net.ServerBridge(tracer=tr_server,
+                              telemetry=Telemetry(tracer=tr_server))
+    sfab = bridge.wrap(fabric_mod.Fabric())
+    traced = net.WorkerBridge("127.0.0.1", bridge.port, [0],
+                              tracer=tr_worker,
+                              telemetry=Telemetry(tracer=tr_worker))
+    legacy = net.WorkerBridge("127.0.0.1", bridge.port, [1])
+    bridge.wait_for_connected([0, 1], timeout=10.0)
+    assert traced.trace_negotiated is True
+    assert legacy.trace_negotiated is False
+
+    tfab, lfab = traced.make_fabric(), legacy.make_fabric()
+    tfab.send(fabric_mod.GRADIENTS_TOPIC, 0, _grad(0, 1))
+    lfab.send(fabric_mod.GRADIENTS_TOPIC, 0, _grad(1, 1))
+    got = {}
+    for _ in range(2):
+        m = sfab.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0, timeout=10.0)
+        assert m is not None
+        got[m.worker_id] = m
+    fid = getattr(got[0], "trace", None)
+    assert isinstance(fid, int)
+    assert fid >> 40 == 22          # worker pid rides the flow id
+    assert getattr(got[1], "trace", None) is None
+
+    # weights back: the traced worker's reader closes the weights flow,
+    # the legacy worker still decodes a plain frame
+    buffers = {0: [], 1: []}
+
+    class _Buf:
+        def add(self, *a, **k):
+            pass
+
+        def add_many(self, *a, **k):
+            pass
+
+    readers = []
+    for wb in (traced, legacy):
+        t = threading.Thread(target=wb.run_reader,
+                             args=({0: _Buf(), 1: _Buf()},), daemon=True)
+        t.start()
+        readers.append(t)
+    for wid, fab in ((0, tfab), (1, lfab)):
+        sfab.send(fabric_mod.WEIGHTS_TOPIC, wid, _weights(2))
+        w = fab.poll_blocking(fabric_mod.WEIGHTS_TOPIC, wid, timeout=10.0)
+        assert w is not None and w.vector_clock == 2
+        np.testing.assert_array_equal(w.values, np.ones(4, np.float32))
+    _ = buffers
+
+    # the traced pair emitted a connected delta flow: 's' on the worker,
+    # 't' on the server; the weights flow ends ('f') on the worker
+    worker_flows = [e for e in tr_worker._events if e.get("cat") == "flow"]
+    server_flows = [e for e in tr_server._events if e.get("cat") == "flow"]
+    assert any(e["ph"] == "s" and e["name"] == "delta.wire"
+               and e["id"] == fid for e in worker_flows)
+    assert any(e["ph"] == "t" and e["name"] == "delta.wire"
+               and e["id"] == fid for e in server_flows)
+    assert any(e["ph"] == "f" and e["name"] == "weights.wire"
+               for e in worker_flows)
+    traced.close(), legacy.close(), bridge.close()
+
+
+def test_trace_negotiation_requires_both_sides():
+    """A traced worker against an untraced server negotiates OFF —
+    the server must never receive a trace suffix it would misparse."""
+    bridge = net.ServerBridge()                   # no tracer
+    sfab = bridge.wrap(fabric_mod.Fabric())
+    tr = Tracer(pid=5)
+    worker = net.WorkerBridge("127.0.0.1", bridge.port, [0], tracer=tr,
+                              telemetry=Telemetry(tracer=tr))
+    bridge.wait_for_connected([0], timeout=10.0)
+    assert worker.trace_negotiated is False
+    fab = worker.make_fabric()
+    fab.send(fabric_mod.GRADIENTS_TOPIC, 0, _grad(0, 1))
+    m = sfab.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0, timeout=10.0)
+    assert m is not None and getattr(m, "trace", None) is None
+    np.testing.assert_array_equal(m.values, np.arange(4, dtype=np.float32))
+    worker.close(), bridge.close()
+
+
+# -- merge CLI --------------------------------------------------------------
+
+def _two_process_traces(tmp_path):
+    """Two tracers faking two processes (distinct pids, offset wall
+    clocks) sharing one flow id across the 'wire'."""
+    clk = {"t": 100.0}
+    t_worker = Tracer(clock=lambda: clk["t"], pid=1, counter_sample_s=0.0)
+    t_server = Tracer(clock=lambda: clk["t"], pid=2, counter_sample_s=0.0)
+    t_server._wall0 = t_worker._wall0 + 0.5   # server started 500 ms later
+    fid = t_worker.new_flow_id()
+    clk["t"] = 100.1
+    with t_worker.span("net.send", topic="gradients"):
+        t_worker.flow_start("delta.wire", fid)
+    clk["t"] = 100.2
+    with t_server.span("server.apply"):
+        t_server.flow_step("delta.wire", fid)
+    t_server.count("gradients.applied")
+    pa = str(tmp_path / "worker.trace.json")
+    pb = str(tmp_path / "server.trace.json")
+    t_worker.dump(pa)
+    t_server.dump(pb)
+    return pa, pb, fid
+
+
+def test_merge_stitches_cross_process_flow(tmp_path):
+    pa, pb, fid = _two_process_traces(tmp_path)
+    out = str(tmp_path / "merged.json")
+    stats = merge_traces([pa, pb], out)
+    assert stats["files"] == 2
+    assert sorted(stats["pids"]) == [1, 2]
+    assert stats["cross_process_flows"] >= 1
+    data = json.loads(Path(out).read_text())
+    evs = data["traceEvents"]
+    flows = [e for e in evs if e.get("cat") == "flow" and e["id"] == fid]
+    assert {e["ph"] for e in flows} == {"s", "t"}
+    assert {e["pid"] for e in flows} == {1, 2}
+    # wall-clock alignment: the server's events shifted +500 ms relative
+    # to its local ts, so the flow step lands after the flow start
+    start = next(e for e in flows if e["ph"] == "s")
+    step = next(e for e in flows if e["ph"] == "t")
+    assert step["ts"] > start["ts"]
+    # per-file process_name metadata present for Perfetto track labels
+    assert any(e.get("ph") == "M" and e.get("name") == "process_name"
+               for e in evs)
+
+
+def test_merge_cli_subprocess(tmp_path):
+    pa, pb, _ = _two_process_traces(tmp_path)
+    out = str(tmp_path / "merged.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "kafka_ps_tpu.telemetry", "merge",
+         "-o", out, pa, pb],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "2 files" in proc.stdout and "cross-process" in proc.stdout
+    assert json.loads(Path(out).read_text())["traceEvents"]
+
+
+# -- bitwise training contract ----------------------------------------------
+
+@pytest.mark.parametrize("consistency", [0, 2, -1])
+def test_telemetry_on_does_not_perturb_theta(consistency):
+    """Training with full telemetry (tracer + metrics) must produce the
+    bit-identical theta of an uninstrumented run, in every consistency
+    model — instrumentation reads host scalars only (PS106)."""
+    from tests.test_runtime import build_app, fill_buffers, make_dataset, \
+        small_cfg
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+
+    def run(telemetry, tracer):
+        cfg = small_cfg(consistency)
+        x, y = make_dataset()
+        app = StreamingPSApp(cfg, test_x=x, test_y=y,
+                             server_log=(lambda s: None),
+                             worker_log=(lambda s: None),
+                             tracer=tracer, telemetry=telemetry)
+        fill_buffers(app, x, y)
+        app.run_serial(max_server_iterations=24)
+        return np.asarray(app.server.theta)
+
+    tracer = Tracer(counter_sample_s=0.0)
+    plain = run(None, None)
+    traced = run(Telemetry(tracer=tracer), tracer)
+    assert plain.tobytes() == traced.tobytes()
+    # the instrumented run actually recorded something
+    assert tracer.counters() or tracer._events
+
+
+def test_gate_histograms_populated_per_model():
+    """gate_wait_ms{model=...} and clock_lag{model=...} fill during a
+    run for each consistency model (the benchable staleness artifact)."""
+    from tests.test_runtime import fill_buffers, make_dataset, small_cfg
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+
+    for c in (0, 2, -1):
+        telemetry = Telemetry()
+        cfg = small_cfg(c)
+        x, y = make_dataset()
+        app = StreamingPSApp(cfg, test_x=x, test_y=y,
+                             server_log=(lambda s: None),
+                             worker_log=(lambda s: None),
+                             telemetry=telemetry)
+        fill_buffers(app, x, y)
+        app.run_serial(max_server_iterations=24)
+        label = f"model={model_name(c)}"
+        snap = telemetry.snapshot()
+        assert snap["gate_wait_ms"][label]["count"] > 0
+        assert snap["clock_lag"][label]["count"] > 0
+        assert sum(snap["gradients_applied_total"].values()) > 0

@@ -7,8 +7,9 @@ from kafka_ps_tpu.utils.trace import NULL_TRACER, Tracer
 
 
 def test_span_and_counter_recording(tmp_path):
-    clock_vals = iter([0.0, 0.0, 1.0, 1.5, 2.0, 5.0])   # t0 + 2 spans
-    t = Tracer(clock=lambda: next(clock_vals))
+    # t0, span a (2), span a (2), count (1), count (1), dump (1)
+    clock_vals = iter([0.0, 0.0, 1.0, 1.5, 2.0, 3.0, 4.0, 5.0])
+    t = Tracer(clock=lambda: next(clock_vals), pid=7)
     with t.span("a", worker=0):
         pass
     with t.span("a"):
@@ -23,10 +24,49 @@ def test_span_and_counter_recording(tmp_path):
 
     path = t.dump(str(tmp_path / "trace.json"))
     data = json.load(open(path))
-    assert len(data["traceEvents"]) == 2
-    ev = data["traceEvents"][0]
+    spans = [e for e in data["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == 2
+    ev = spans[0]
     assert ev["ph"] == "X" and ev["dur"] == 1e6
     assert ev["args"] == {"worker": 0}
+    assert ev["pid"] == 7
+    assert data["pid"] == 7
+    assert "wallClockT0" in data
+
+
+def test_counter_timeline_samples(tmp_path):
+    """Counters export as ph:'C' timeline events, not just totals."""
+    clock_vals = iter([0.0, 1.0, 2.0, 3.0, 4.0])   # t0, 3 counts, dump
+    t = Tracer(clock=lambda: next(clock_vals), pid=1, counter_sample_s=0.0)
+    t.count("frames", 2)
+    t.count("frames")
+    t.count("other")
+    path = t.dump(str(tmp_path / "trace.json"))
+    data = json.load(open(path))
+    cs = [e for e in data["traceEvents"] if e["ph"] == "C"]
+    frames = [e for e in cs if e["name"] == "frames"]
+    # 2 throttle-off samples + 1 closing sample at dump
+    assert [e["args"]["value"] for e in frames] == [2, 3, 3]
+    assert frames[0]["ts"] == 1e6
+    assert any(e["name"] == "other" for e in cs)
+    assert data["counters"] == {"frames": 3, "other": 1}
+
+
+def test_flow_events(tmp_path):
+    t = Tracer(pid=3)
+    fid = t.new_flow_id()
+    assert fid >> 40 == 3            # pid folded into the id
+    t.flow_start("delta.wire", fid, worker=1)
+    t.flow_step("delta.wire", fid)
+    t.flow_end("delta.wire", fid)
+    path = t.dump(str(tmp_path / "trace.json"))
+    data = json.load(open(path))
+    flows = [e for e in data["traceEvents"] if e.get("cat") == "flow"]
+    assert [e["ph"] for e in flows] == ["s", "t", "f"]
+    assert all(e["id"] == fid for e in flows)
+    assert flows[2]["bp"] == "e"
+    assert flows[0]["args"] == {"worker": 1}
+    assert t.new_flow_id() != fid
 
 
 def test_span_records_on_exception():
@@ -44,6 +84,8 @@ def test_null_tracer_noops():
     with NULL_TRACER.span("x"):
         pass
     NULL_TRACER.count("y")
+    NULL_TRACER.flow_start("f", 1)
+    NULL_TRACER.flow_end("f", 1)
     assert NULL_TRACER.span_stats() == {}
     assert NULL_TRACER.counters() == {}
 
