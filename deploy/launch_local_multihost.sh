@@ -6,6 +6,13 @@
 #
 #   deploy/launch_local_multihost.sh [N_PROCESSES] [extra cli args...]
 #
+# Range-sharded split deployment (docs/SHARDING.md) on one machine —
+# N shard-server processes, each owning a contiguous key range of
+# theta (its own gate, checkpoint, and durable-log partition), plus
+# one worker process connected to all of them:
+#
+#   deploy/launch_local_multihost.sh --sharded [N_SHARDS] [server args...]
+#
 # Writes logs-server.csv (+ logs-worker*.csv) into $PWD.
 set -euo pipefail
 
@@ -14,6 +21,33 @@ shift || true
 PORT=$(( 20000 + RANDOM % 20000 ))
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+
+if [ "$NPROCS" = "--sharded" ]; then
+  NSHARDS="${1:-2}"
+  shift || true
+  export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+  if [ ! -f ./train.csv ]; then
+    python -m kafka_ps_tpu.data.synth --out_dir . --rows 2000 \
+        --test_rows 400 --hard --num_features 64
+  fi
+  pids=()
+  addrs=""
+  for i in $(seq 0 $((NSHARDS - 1))); do
+    python -m kafka_ps_tpu.cli.server_runner \
+        --listen "$((PORT + i))" --shards "$NSHARDS" --shard-id "$i" \
+        -training ./train.csv -test ./test.csv --num_features 64 \
+        -c 0 -p 1 --num_workers 2 --max_iterations 200 "$@" &
+    pids+=($!)
+    addrs="${addrs:+$addrs,}127.0.0.1:$((PORT + i))"
+  done
+  python -m kafka_ps_tpu.cli.worker_runner \
+      --connect "$addrs" --worker_ids 0,1 -test ./test.csv \
+      --num_features 64 -min 8 -max 32 &
+  pids+=($!)
+  for p in "${pids[@]}"; do wait "$p"; done
+  echo "done: $NSHARDS shards, ranges reassembled by the worker pulls"
+  exit 0
+fi
 export KPS_PLATFORM=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=2"
 export KPS_COORDINATOR="127.0.0.1:$PORT"

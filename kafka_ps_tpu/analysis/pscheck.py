@@ -16,9 +16,13 @@ time; these rules catch the regressions at commit time instead:
          on a non-literal receiver): messages carry verbatim
          ``encoded`` parts; int8 quantization is not idempotent.
   PS104  nondeterminism in replay-critical modules (``log/``,
-         ``compress/``, ``runtime/serde.py``): wall clocks, ``random``,
+         ``compress/``, ``runtime/serde.py``, ``runtime/sharding.py``,
+         ``parallel/range_sharded.py``): wall clocks, ``random``,
          ``np.random``, ``uuid``/``urandom``, and iteration over a
          bare ``set(...)`` (hash order) — replay must be bitwise.
+         The sharding modules are replay-critical because per-shard
+         durable-log recovery is bitwise only if routing and assembly
+         order depend on (shard, worker, clock) alone.
   PS105  blocking I/O (socket send/recv, frame send/recv, ``fsync``,
          ``time.sleep``) while holding a lock.
   PS106  host-sync calls (``.item()``, ``float()``, ``np.asarray``,
@@ -525,7 +529,9 @@ def _rules_for(path: Path) -> set:
     if path.name in ("serde.py", "net.py"):
         rules.add("PS103")
     if ("log" in parts or "compress" in parts
-            or (path.name == "serde.py" and "runtime" in parts)):
+            or (path.name == "serde.py" and "runtime" in parts)
+            or (path.name == "sharding.py" and "runtime" in parts)
+            or (path.name == "range_sharded.py" and "parallel" in parts)):
         rules.add("PS104")
     return rules
 

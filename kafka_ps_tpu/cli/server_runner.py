@@ -31,6 +31,17 @@ def build_parser() -> argparse.ArgumentParser:
              "separate-server-JVM topology (run.sh:15-18)")
     parser.add_argument("--connect_timeout", type=float, default=60.0,
                         help="--listen: seconds to wait for all workers")
+    parser.add_argument(
+        "--shards", type=int, default=1, metavar="N",
+        help="--listen: total server shards of a range-sharded "
+             "deployment (docs/SHARDING.md) — run N of these processes, "
+             "one per --shard-id, each owning a contiguous key range of "
+             "theta with its own consistency gate, checkpoint file and "
+             "durable-log partition; workers --connect to all N")
+    parser.add_argument(
+        "--shard-id", dest="shard_id", type=int, default=0, metavar="I",
+        help="--shards: this process's shard index in [0, N) — shard 0 "
+             "additionally hosts the stream producer")
     return parser
 
 
@@ -39,14 +50,31 @@ def main(argv=None) -> int:
     # worker-side defaults (WorkerAppRunner.java:55-58)
     args = argparse.Namespace(min_buffer_size=128, max_buffer_size=1024,
                               buffer_size_coefficient=0.3, **vars(args))
+    if args.shards < 1 or not 0 <= args.shard_id < args.shards:
+        raise SystemExit(
+            f"--shard-id {args.shard_id} must be in [0, --shards "
+            f"{args.shards}) and --shards must be >= 1")
+    if args.shards > 1 and args.listen is None:
+        raise SystemExit("--shards N > 1 requires --listen (one shard "
+                         "server process per port, docs/SHARDING.md); "
+                         "in-process sharding is the "
+                         "runtime.sharding.ShardedServerGroup API")
     if args.listen is not None:
+        if args.shards > 1:
+            # sharded split mode OWNS a durable-log story: one commit-
+            # log partition per shard process, replayed on restart —
+            # the SIGKILL-recovery path (scripts/tier1.sh --shard)
+            from kafka_ps_tpu.cli import socket_mode
+            return socket_mode.run_server_shard(args)
         if getattr(args, "durable_log", None):
             # the socket split already has its own durability story
             # (--checkpoint + per-worker state files, cli/socket_mode);
             # the commit log is the in-process fabric's
             raise SystemExit(
                 "--durable-log applies to the in-process fabric; in "
-                "--listen split mode use --checkpoint instead")
+                "--listen split mode use --checkpoint instead (or "
+                "--shards N > 1, whose shard processes each own a "
+                "durable-log partition)")
         from kafka_ps_tpu.cli import socket_mode
         return socket_mode.run_server(args)
     return run_mod.run_with_args(args)

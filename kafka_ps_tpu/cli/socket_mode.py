@@ -409,7 +409,15 @@ def run_server(args) -> int:
 
 
 def run_worker(args) -> int:
-    """Worker role: the logical workers in --worker_ids, server remote."""
+    """Worker role: the logical workers in --worker_ids, server remote.
+
+    `--connect` with a comma-separated address list enters the
+    range-sharded deployment (docs/SHARDING.md): one connection per
+    shard-server process, gradient slices routed per shard, weights
+    slices reassembled at a common clock."""
+    if "," in args.connect:
+        return _run_worker_sharded(
+            args, [a for a in args.connect.split(",") if a])
     from kafka_ps_tpu.cli.run import load_test_csv
     from kafka_ps_tpu.data.buffer import SlidingBuffer
     from kafka_ps_tpu.runtime.worker import WorkerNode
@@ -627,6 +635,416 @@ def run_worker(args) -> int:
         # a thread survived its join and may be inside native code:
         # skip interpreter finalization entirely rather than risk the
         # teardown abort (this is a CLI process, nothing else to run)
+        print(f"warning: threads still alive at exit: {leftover}; "
+              "exiting without finalization", file=sys.stderr, flush=True)
+        sys.stdout.flush()
+        os._exit(rc)
+    if errors:
+        raise RuntimeError("worker failed") from errors[0]
+    return 0
+
+
+# -- range-sharded split deployment (docs/SHARDING.md) -----------------------
+
+def run_server_shard(args) -> int:
+    """One shard-server process of a `--shards N` split deployment:
+    owns `ShardPlan.ranges[shard_id]` of theta with its own per-worker
+    vector clocks, its own consistency gate (all three models evaluate
+    per shard), its own per-shard checkpoint file
+    (utils/checkpoint.shard_state_path) and — with `--durable-log DIR`
+    — its own commit-log partition under `DIR/shard<I>of<N>`, so a
+    SIGKILL'd shard recovers bitwise from checkpoint + log-tail replay
+    while the other shards keep running (scripts/tier1.sh --shard).
+
+    Shard 0 additionally hosts the stream producer (the data plane is
+    unsharded — rows go to workers, not servers).  No shard hosts the
+    server-side eval or the serving plane: each owns only a slice, and
+    assembled-theta serving is the in-process ShardedServerGroup /
+    FrontierCutPublisher story.  Worker-side gradient sparsification
+    (`--compress topk:R` on the WORKER processes) is what shrinks the
+    per-shard wire traffic; shard servers themselves run uncompressed
+    weights slices.
+    """
+    from kafka_ps_tpu.models.task import get_task
+    from kafka_ps_tpu.data.stream import CsvStreamProducer
+    from kafka_ps_tpu.runtime.server import ServerNode
+    from kafka_ps_tpu.runtime.sharding import ShardPlan
+    from kafka_ps_tpu.utils import checkpoint as ckpt
+
+    cfg = _make_cfg(args)
+    num_shards, shard_id = args.shards, args.shard_id
+    plan = ShardPlan(get_task(cfg.task, cfg.model).num_params, num_shards)
+    key_range = plan.ranges[shard_id]
+    if getattr(args, "serve", False):
+        raise SystemExit(
+            "--serve is unsharded-only in split mode: a shard process "
+            "holds one theta slice; assembled-theta serving is the "
+            "in-process ShardedServerGroup path (docs/SHARDING.md)")
+    failure_policy = getattr(args, "failure_policy", "halt")
+    hb_timeout = getattr(args, "heartbeat_timeout", None)
+
+    checkpoint_path = None
+    if getattr(args, "checkpoint", None):
+        checkpoint_path = ckpt.shard_state_path(
+            args.checkpoint, shard_id, num_shards)
+    resuming = bool(checkpoint_path) and os.path.exists(checkpoint_path)
+    run_id = ckpt.peek_run_id(checkpoint_path) if resuming else None
+    if run_id is None:
+        run_id = time.time_ns()
+
+    tracer, telemetry = _make_telemetry(args)
+    inner = fabric_mod.Fabric()
+    if getattr(args, "durable_log", None):
+        # one durable-log partition set per shard: gradients keyed 0
+        # locally, rooted under a shard-suffixed directory so N shard
+        # processes never share a segment file
+        from kafka_ps_tpu.log import DurableFabric, LogConfig
+        inner = DurableFabric(
+            os.path.join(args.durable_log,
+                         f"shard{shard_id}of{num_shards}"),
+            LogConfig(fsync=getattr(args, "fsync", "interval")),
+            tracer=tracer, telemetry=telemetry)
+    bridge = net.ServerBridge(
+        port=args.listen,
+        heartbeat_interval=min(1.0, hb_timeout / 3) if hb_timeout else 1.0,
+        heartbeat_timeout=hb_timeout,
+        run_id=run_id, tracer=tracer, telemetry=telemetry)
+    print(f"shard {shard_id}/{num_shards} range "
+          f"[{key_range.start}, {key_range.end}) listening on port "
+          f"{bridge.port}", file=sys.stderr, flush=True)
+    fabric = bridge.wrap(inner)     # preserves DurableFabric's class
+    server = ServerNode(cfg, fabric, None, None, None,
+                        tracer=tracer, telemetry=telemetry,
+                        key_range=key_range, shard_id=shard_id,
+                        num_shards=num_shards)
+    server.run_id = run_id
+    if checkpoint_path:
+        ckpt.maybe_restore(checkpoint_path, server)
+        server.checkpoint_path = checkpoint_path
+        server.checkpoint_every = getattr(args, "checkpoint_every", 50)
+        if resuming:
+            print(f"shard {shard_id}: restored checkpoint at iteration "
+                  f"{server.iterations}", file=sys.stderr, flush=True)
+    if getattr(inner, "durable", False):
+        # crash recovery: re-enqueue the unconsumed gradient-slice tail
+        # past the checkpoint's committed offsets; the tracker dedups
+        # whatever the checkpoint already covers (at-least-once replay)
+        counts = inner.recover(server.restored_log_offsets)
+        if any(counts.values()):
+            print(f"shard {shard_id}: durable-log replay {counts}",
+                  file=sys.stderr, flush=True)
+
+    events: "queue.Queue[tuple[str, object]]" = queue.Queue()
+    bridge.on_disconnect = lambda ids: events.put(("disconnect", ids))
+    bridge.on_ready = lambda w: events.put(("ready", w))
+    workers = server.tracker.active_workers
+    bridge.wait_for_connected(workers, timeout=args.connect_timeout)
+
+    producer = None
+    batch_sink = None
+    reroute = {"rr": 0, "dropped": 0}
+    if shard_id == 0:
+        # the data plane lives on shard 0 only — same sink/reroute
+        # policy as the unsharded run_server
+        def sink(worker: int, features: dict[int, float],
+                 label: int) -> None:
+            deliverable = (failure_policy == "rebalance"
+                           or server.tracker.tracker[worker].active)
+            if deliverable and bridge.send_data(worker, features, label):
+                return
+            active = server.tracker.active_workers
+            for _ in range(len(active)):
+                alt = active[reroute["rr"] % len(active)]
+                reroute["rr"] += 1
+                if alt != worker and bridge.send_data(alt, features,
+                                                      label):
+                    return
+            reroute["dropped"] += 1
+
+        batch_sink = _BatchingSink(
+            bridge, sink,
+            deliverable=lambda w: (failure_policy == "rebalance"
+                                   or server.tracker.tracker[w].active))
+        producer = CsvStreamProducer(
+            args.training_data_file_path, cfg.num_workers, batch_sink,
+            time_per_event_ms=cfg.stream.time_per_event_ms,
+            prefill_per_worker=cfg.stream.prefill_per_worker)
+        producer.run_in_background()
+    bridge.wait_for_workers(workers, timeout=args.connect_timeout)
+
+    def apply_events() -> None:
+        while True:
+            try:
+                kind, val = events.get_nowait()
+            except queue.Empty:
+                return
+            if kind == "disconnect":
+                live = [w for w in val
+                        if server.tracker.tracker[w].active]
+                if not live:
+                    continue
+                if failure_policy == "halt":
+                    raise RuntimeError(
+                        f"shard {shard_id}: worker connection lost for "
+                        f"{sorted(live)} (failure_policy=halt)")
+                for w in live:
+                    try:
+                        server.remove_worker(w)
+                    except ValueError:
+                        raise RuntimeError(
+                            "all worker connections lost") from None
+            elif kind == "ready" and failure_policy == "rebalance":
+                w = int(val)
+                if not server.tracker.tracker[w].active:
+                    server.readmit_worker(w)
+
+    server.start_training_loop()
+    max_iters = args.max_iterations or sys.maxsize
+    try:
+        while server.iterations < max_iters:
+            apply_events()
+            if batch_sink is not None:
+                batch_sink.flush_aged()
+            g = fabric.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0,
+                                     timeout=0.2)
+            if g is not None:
+                server.process(g)
+    except KeyboardInterrupt:
+        print(f"shard {shard_id}: interrupted — shutting down",
+              file=sys.stderr, flush=True)
+    finally:
+        if producer is not None:
+            producer.stop()
+        if batch_sink is not None:
+            batch_sink.flush_all()
+        bridge.close()
+        if checkpoint_path:
+            # commit point: checkpoint + committed log offsets describe
+            # the same instant (ServerNode.save_checkpoint_now commits
+            # a durable fabric's offsets after the save)
+            server.save_checkpoint_now()
+        if getattr(inner, "durable", False):
+            inner.close()
+        if reroute["dropped"] or bridge.dropped_sends:
+            print(f"shard {shard_id}: dropped rows "
+                  f"{reroute['dropped']}, dropped sends "
+                  f"{bridge.dropped_sends}", file=sys.stderr, flush=True)
+        _dump_telemetry(args, tracer, telemetry)
+    return 0
+
+
+class _AssemblerSink:
+    """Per-bridge weights sink (net.WorkerBridge.set_weights_sink):
+    feeds one shard's weights slices into the shared WeightsAssembler
+    under a lock — N reader threads offer concurrently, and assembly
+    state must mutate atomically per slice."""
+
+    def __init__(self, shard_id: int, assembler, lock):
+        self._shard_id = shard_id
+        self._assembler = assembler
+        self._lock = lock
+
+    def send(self, topic: str, key: int, message) -> None:
+        with self._lock:
+            self._assembler.offer(self._shard_id, key, message)
+
+
+def _run_worker_sharded(args, addrs: list[str]) -> int:
+    """Worker role against a `--shards N` server fleet: one bridge per
+    shard address (in shard-id order), a ShardRouter per logical worker
+    splitting each delta into per-shard slices, and a WeightsAssembler
+    reassembling per-shard weights slices into the one full-range
+    message the WorkerNodes train on.
+
+    A dead bridge is NOT fatal while any other shard is alive: the
+    supervisor reconnects to the restarted shard process, and the
+    router's redelivery cache resends the gradient slices the dead
+    shard missed (bitwise — never recomputed).  The run ends when every
+    shard has closed its connection (servers reached max iterations)."""
+    from kafka_ps_tpu.cli.run import load_test_csv
+    from kafka_ps_tpu.data.buffer import SlidingBuffer
+    from kafka_ps_tpu.models.task import get_task
+    from kafka_ps_tpu.runtime.sharding import ShardPlan, ShardRouter, \
+        WeightsAssembler
+    from kafka_ps_tpu.runtime.worker import WorkerNode
+    from kafka_ps_tpu.utils.csvlog import CsvLogSink, WORKER_HEADER
+
+    ids = [int(w) for w in args.worker_ids.split(",")]
+    cfg = _make_cfg(args)
+    test_x, test_y = load_test_csv(args.test_data_file_path,
+                                   args.num_features)
+    num_params = get_task(cfg.task, cfg.model).num_params
+    plan = ShardPlan(num_params, len(addrs))
+    tracer, telemetry = _make_telemetry(args)
+
+    def connect(addr: str, timeout: float = 30.0):
+        host, _, port = addr.rpartition(":")
+        return net.WorkerBridge(host or "127.0.0.1", int(port), ids,
+                                connect_timeout=timeout,
+                                heartbeat_timeout=getattr(
+                                    args, "heartbeat_timeout", None),
+                                tracer=tracer, telemetry=telemetry)
+
+    slots: list = [connect(a) for a in addrs]
+
+    fabric = fabric_mod.Fabric()        # local: assembled WEIGHTS only
+    assemble_lock = OrderedLock("ShardedWorker.assemble")
+    routers: dict[int, ShardRouter] = {}
+
+    def resend_cb(shard_id: int, worker: int, clock: int) -> bool:
+        router = routers.get(worker)
+        return router.resend(shard_id, clock) if router else False
+
+    assembler = WeightsAssembler(
+        plan,
+        deliver=lambda w, m: fabric.send(fabric_mod.WEIGHTS_TOPIC, w, m),
+        resend=resend_cb)
+    sinks = [_AssemblerSink(i, assembler, assemble_lock)
+             for i in range(len(addrs))]
+    for i, b in enumerate(slots):
+        b.set_weights_sink(sinks[i])
+
+    def safe_send(shard_id: int, message) -> None:
+        # a slice to a crashed shard is dropped here and recovered by
+        # the redelivery protocol once the shard is back (the router
+        # cache holds it; the shard's stale weights slice triggers the
+        # resend) — the worker must not die on a shard's crash
+        try:
+            slots[shard_id].send_gradients(0, message)
+        except (ConnectionError, OSError):
+            pass
+
+    for w in ids:
+        routers[w] = ShardRouter(plan, send=safe_send)
+
+    compressors = None
+    spec = _codec_spec(args)
+    if spec.codec_id != net.CODEC_NONE:
+        # no per-connection negotiation in the sharded fleet: slices
+        # cross the wire DECODED (dense tid-1 / sparse tid-6 frames),
+        # so --compress here is the local gradient sparsifier — topk
+        # is what makes a delta touch few shards (docs/SHARDING.md)
+        from kafka_ps_tpu import compress
+        codec = compress.get_codec(spec, num_params)
+        compressors = {w: compress.ErrorFeedback(codec) for w in ids}
+        print(f"compression: {spec.name} (local sparsifier)",
+              file=sys.stderr, flush=True)
+
+    buffers = {w: SlidingBuffer(cfg.model.num_features, cfg.buffer,
+                                telemetry=telemetry, worker=w)
+               for w in ids}
+    log = CsvLogSink("./logs-worker.csv" if args.logging else None,
+                     WORKER_HEADER)
+    from kafka_ps_tpu.utils.asynclog import DeferredSink
+    worker_log = DeferredSink(log)
+    nodes = {w: WorkerNode(w, cfg, fabric, buffers[w], test_x, test_y,
+                           worker_log, tracer=tracer, telemetry=telemetry)
+             for w in ids}
+    for w in ids:
+        nodes[w].shard_router = routers[w]
+        if compressors is not None:
+            nodes[w].compressor = compressors[w]
+
+    reader_threads: list[threading.Thread] = []
+
+    def start_reader(bridge) -> None:
+        t = threading.Thread(target=bridge.run_reader, args=(buffers,),
+                             daemon=True, name="kps-worker-reader")
+        t.start()
+        reader_threads.append(t)
+
+    for b in slots:
+        start_reader(b)
+
+    stop = threading.Event()
+
+    def announce_ready() -> None:
+        pending = {(i, w) for i in range(len(slots)) for w in ids}
+        while pending and not stop.is_set():
+            for i, w in list(pending):
+                if buffers[w].count > 0:
+                    try:
+                        slots[i].mark_ready(w)
+                    except (ConnectionError, OSError):
+                        continue
+                    pending.discard((i, w))
+            time.sleep(0.01)
+
+    ready_thread = threading.Thread(target=announce_ready, daemon=True,
+                                    name="kps-worker-ready")
+    ready_thread.start()
+
+    def supervise() -> None:
+        # reconnect crashed shards; end the run when the whole fleet is
+        # gone (normal completion: every shard closes at max iterations)
+        while not stop.is_set():
+            for i in range(len(slots)):
+                if not slots[i].disconnected.is_set():
+                    continue
+                if all(s.disconnected.is_set() for s in slots):
+                    stop.set()
+                    return
+                try:
+                    nb = connect(addrs[i], timeout=3.0)
+                except (ConnectionError, OSError):
+                    continue        # shard still down; retry next sweep
+                nb.set_weights_sink(sinks[i])
+                start_reader(nb)
+                slots[i] = nb
+                for w in ids:
+                    if buffers[w].count > 0:
+                        try:
+                            nb.mark_ready(w)
+                        except (ConnectionError, OSError):
+                            pass
+                print(f"reconnected to shard {i} ({addrs[i]})",
+                      file=sys.stderr, flush=True)
+            time.sleep(0.2)
+
+    supervisor = threading.Thread(target=supervise, daemon=True,
+                                  name="kps-worker-supervisor")
+    supervisor.start()
+
+    errors: list[BaseException] = []
+
+    def worker_loop(node: WorkerNode) -> None:
+        try:
+            while not stop.is_set():
+                msg = fabric.poll_blocking(fabric_mod.WEIGHTS_TOPIC,
+                                           node.worker_id, timeout=0.1)
+                if msg is not None:
+                    node.on_weights(msg)
+        except BaseException as e:    # pragma: no cover - diagnostics
+            errors.append(e)
+            stop.set()
+
+    threads = [threading.Thread(target=worker_loop, args=(nodes[w],),
+                                daemon=True, name=f"worker-{w}")
+               for w in ids]
+    for t in threads:
+        t.start()
+    stop.wait()                       # supervisor ends the run
+    leftover = []
+    for t in threads:
+        t.join(timeout=120.0)
+        if t.is_alive():
+            leftover.append(t.name)
+    worker_log.close()
+    for b in slots:
+        b.close()
+    supervisor.join(timeout=10.0)
+    ready_thread.join(timeout=10.0)
+    for t in reader_threads:
+        t.join(timeout=10.0)
+    for t in [supervisor, ready_thread, *reader_threads]:
+        if t.is_alive():
+            leftover.append(t.name)
+    _dump_telemetry(args, tracer, telemetry)
+    rc = 0
+    if errors:
+        print(f"worker failed: {errors[0]!r}", file=sys.stderr, flush=True)
+        rc = 1
+    if leftover:
         print(f"warning: threads still alive at exit: {leftover}; "
               "exiting without finalization", file=sys.stderr, flush=True)
         sys.stdout.flush()

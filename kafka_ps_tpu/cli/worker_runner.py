@@ -21,11 +21,13 @@ def build_parser() -> argparse.ArgumentParser:
                                   include_worker_flags=True,
                                   prog="WorkerAppRunner")
     parser.add_argument(
-        "--connect", default=None, metavar="HOST:PORT",
+        "--connect", default=None, metavar="HOST:PORT[,HOST:PORT...]",
         help="split deployment: host ONLY the logical workers in "
              "--worker_ids against a remote --listen server "
              "(cli/socket_mode.py) — the reference's worker-JVM role "
-             "(run.sh:10-13)")
+             "(run.sh:10-13).  A comma-separated list connects to a "
+             "--shards N server fleet, one address per shard in "
+             "shard-id order (docs/SHARDING.md)")
     parser.add_argument("--worker_ids", default="0",
                         help="--connect: comma-separated logical worker "
                              "ids this process hosts")

@@ -32,6 +32,10 @@ import numpy as np
 CODEC_NONE = 0
 CODEC_BF16 = 1
 CODEC_INT8 = 2
+# topk parts are (indices, values) over the full key space — which is
+# why a range-sharded router can split a topk delta by index range
+# into per-shard SparseDeltaMessages without decoding it
+# (runtime/sharding.ShardPlan.split_sparse, docs/SHARDING.md)
 CODEC_TOPK = 3
 
 _CODEC_NAMES = {CODEC_NONE: "none", CODEC_BF16: "bf16",

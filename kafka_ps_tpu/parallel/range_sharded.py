@@ -35,6 +35,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kafka_ps_tpu.parallel.mesh import PARAM_AXIS, WORKER_AXIS
 from kafka_ps_tpu.utils.config import ModelConfig
 
+# jax.shard_map graduated from jax.experimental in 0.5; support both
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def padded_num_params(layout, num_param_shards: int) -> int:
     """theta length padded so every param shard is equal-size (static
@@ -103,7 +109,9 @@ def make_range_sharded_step(cfg: ModelConfig, num_workers: int,
         # weights pull: reassemble the full replica from the server shards
         theta_full = jax.lax.all_gather(theta_shard, PARAM_AXIS, axis=0,
                                         tiled=True)
-        theta_full = jax.lax.pcast(theta_full, WORKER_AXIS, to="varying")
+        if hasattr(jax.lax, "pcast"):      # varying-axis annotation is
+            theta_full = jax.lax.pcast(    # jax >= 0.7; a no-op before
+                theta_full, WORKER_AXIS, to="varying")
         deltas, losses = jax.vmap(
             lambda xx, yy, mm: local_update_padded(theta_full, xx, yy, mm)
         )(x, y, mask)
@@ -126,16 +134,38 @@ def make_range_sharded_step(cfg: ModelConfig, num_workers: int,
         return theta, (losses[0] if rounds == 1 else losses)
 
     data_spec = P((WORKER_AXIS, PARAM_AXIS))
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         shard_body, mesh=mesh,
         in_specs=(P(PARAM_AXIS), data_spec, data_spec, data_spec),
         out_specs=(P(PARAM_AXIS), P()))
     return jax.jit(sharded)
 
 
+def assert_pad_clean(theta_padded, layout) -> None:
+    """Pad-hygiene invariant: the pad keys appended by `pad_theta` are
+    DEAD — `local_update_padded` zero-pads every delta, so nothing may
+    ever land there.  A nonzero pad region means a delta leaked past
+    `num_params` (a kernel writing out of its logical range, or a theta
+    padded from a wrong layout) and the real parameters adjacent to the
+    boundary can no longer be trusted.  unshard_theta would silently
+    drop the evidence; this check turns the leak into an error at the
+    unshard boundary (regression: tests/test_range_sharded.py)."""
+    n = layout.num_params
+    pad = np.asarray(theta_padded[n:])
+    if pad.size and np.any(pad != 0):
+        bad = int(np.flatnonzero(pad)[0])
+        raise ValueError(
+            f"delta leaked into the shard pad region: key {n + bad} "
+            f"(pad begins at {n}, padded length {len(theta_padded)}) "
+            f"holds {float(pad[bad])!r}, expected 0")
+
+
 def unshard_theta(theta_padded, layout) -> np.ndarray:
     """Back to the host-side flat layout (drops the shard padding).
     `layout` as in padded_num_params.  Returns a WRITABLE copy — the
     server's message path mutates theta in place (runtime/server.py),
-    and an asarray view of a JAX array is read-only."""
+    and an asarray view of a JAX array is read-only.  Asserts the pad
+    region it drops is clean (assert_pad_clean) — dropping a nonzero
+    pad would hide a range leak."""
+    assert_pad_clean(theta_padded, layout)
     return np.array(theta_padded[:layout.num_params])

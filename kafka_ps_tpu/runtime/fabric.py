@@ -14,6 +14,13 @@ Doubles as the deterministic test harness the reference declared a
 dependency for but never used (kafka-streams-test-utils, build.gradle:51
 — SURVEY §4): tests drive `poll` directly for fully deterministic
 scheduling.
+
+Key conventions: WEIGHTS is keyed by worker id everywhere.  GRADIENTS
+is keyed 0 for the single server; a range-sharded group
+(runtime/sharding.py, docs/SHARDING.md) keys it by SHARD id — shard i
+polls (GRADIENTS_TOPIC, i) and workers' routers address slices to the
+owning shard, so the many-to-one gather becomes N independent per-key
+FIFOs with no fabric change.
 """
 
 from __future__ import annotations

@@ -31,6 +31,15 @@ weights message covered by a notice becomes the gang leader and polls
 the fabric for siblings already enqueued — no timer sleeps on the hot
 path.  Members whose threads beat the leader to their own messages
 simply run solo there; a gang is an optimization, never a barrier.
+
+Range sharding (runtime/sharding.py): every shard's gate computes the
+identical release sets in lockstep (same gradients, same clocks), so
+only SHARD 0 forwards its GangNotice — N notices for one release
+moment would be noise — and the worker-side claim fires once the
+assembler has synthesized the full-range weights at the common clock.
+Server-side, gang applies coalesce per shard (each shard's
+process_batch chains its own slice applies); there is no cross-shard
+barrier in the dispatch path.
 """
 
 from __future__ import annotations

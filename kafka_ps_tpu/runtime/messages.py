@@ -96,6 +96,34 @@ class GradientMessage(BaseMessage):
 
 
 @dataclasses.dataclass(frozen=True)
+class SparseDeltaMessage:
+    """worker → server shard: a sparsified delta slice (range sharding,
+    docs/SHARDING.md).  NOT a BaseMessage — `values` here is the sparse
+    value list, not a dense slab over the range, so the dense length
+    invariant does not apply.
+
+    `indices` are LOCAL offsets within `key_range` (global key =
+    key_range.start + index), sorted ascending, unique.  An EMPTY slice
+    (no surviving top-k coordinates in this shard's range) is still a
+    protocol message: the shard's consistency gate must see one gradient
+    per (worker, clock) to advance its vector clocks — the apply is
+    skipped, the bookkeeping is not."""
+
+    vector_clock: int
+    key_range: KeyRange
+    indices: np.ndarray          # int32 local offsets, may be empty
+    values: np.ndarray           # float32, same length as indices
+    worker_id: int = 0
+    encoded: EncodedValues | None = None   # API parity with BaseMessage
+
+    def __post_init__(self):
+        if len(self.indices) != len(self.values):
+            raise ValueError(
+                f"indices length {len(self.indices)} != values length "
+                f"{len(self.values)}")
+
+
+@dataclasses.dataclass(frozen=True)
 class GangNotice:
     """Server → drive loop: the gate just released `members` (worker id,
     clock) at the same moment, and their per-worker WeightsMessages are

@@ -50,6 +50,17 @@ as one connected arrow chain in Perfetto after the merge CLI
 (`python -m kafka_ps_tpu.telemetry merge`).  Old peers never offer and
 never see a suffix — a legacy fleet stays byte-identical.
 
+Range sharding (docs/SHARDING.md): a sharded deployment runs N of
+these bridges — one per shard-server process — and every worker
+process holds N WorkerBridge connections.  The frames themselves need
+no new fields: the shard/range header rides INSIDE the serde payload
+(every weights/gradient message carries a KeyRange; sparse slices are
+tid-6 SparseDeltaMessage frames whose key_range names the owning
+shard's span), so an unsharded peer speaks the same wire format.
+GRADIENT sends go out per-bridge via `WorkerBridge.send_gradients`
+(the ShardRouter's hook) and WEIGHTS slices land per-bridge into the
+assembler via `set_weights_sink`.
+
 Delivery properties preserved from the reference fabric: addressed
 per-worker delivery, per-connection FIFO (TCP), asynchronous buffering
 (the consistency gate never blocks on a send).  Cites:
@@ -322,7 +333,12 @@ class ServerBridge:
     def wrap(self, fabric: fabric_mod.Fabric) -> fabric_mod.Fabric:
         bridge = self
 
-        class BridgedFabric(fabric_mod.Fabric):
+        # subclass the wrapped fabric's OWN class, not the base Fabric:
+        # wrapping a log.durable_fabric.DurableFabric must keep its
+        # append-before-enqueue send and its recover/commit surface —
+        # the sharded split deployment (--shards N --durable-log, one
+        # log partition per shard process) relies on exactly that
+        class BridgedFabric(type(fabric)):
             def send(self, topic, key, message):
                 conn = bridge._conn_of.get(key) \
                     if topic == fabric_mod.WEIGHTS_TOPIC else None
@@ -331,11 +347,11 @@ class ServerBridge:
                 else:
                     super().send(topic, key, message)
 
-        out = BridgedFabric()
-        # share state with the original so pre-wrap queues stay visible
-        out._queues = fabric._queues
-        out._cond = fabric._cond
-        out._tracer = fabric._tracer
+        out = object.__new__(BridgedFabric)
+        # share ALL state with the original (queues, cond, tracer — and
+        # any subclass state such as the durable log writer) so
+        # pre-wrap queues and already-appended partitions stay visible
+        out.__dict__ = fabric.__dict__
         self._fabric = out
         return out
 
@@ -747,6 +763,36 @@ class WorkerBridge:
         self._sock.settimeout(heartbeat_timeout)
         self._apply_server_ping_interval(interval)
 
+    def send_gradients(self, key: int, message) -> None:
+        """Serialize one gradient message (full-range, or a per-shard
+        dense/sparse slice — serde handles both) and send it on THIS
+        bridge's socket.  The make_fabric() path calls it for the
+        single-connection deployment; a sharded worker process calls it
+        directly as the ShardRouter's per-shard send hook, one bridge
+        per shard (runtime/sharding.py, docs/SHARDING.md)."""
+        payload = serde.to_bytes(message)
+        if self.trace_negotiated:
+            # open the delta flow: this send slice is the wire
+            # segment's source; the server's net.recv is the first
+            # step of the arrow chain.  Each shard slice gets its OWN
+            # flow id — one Perfetto arrow chain per routed slice.
+            fid = self._tracer.new_flow_id()
+            with self._tracer.span(
+                    "net.send", topic="gradients",
+                    worker=getattr(message, "worker_id", key)):
+                self._tracer.flow_start("delta.wire", fid)
+            payload += _TRACE_CTX.pack(fid, 0)
+        locked_send(self._sock, self._send_lock,
+                    T_GRADIENTS, key, payload)
+        with self._wire_lock:
+            self.wire_bytes[T_GRADIENTS] = (
+                self.wire_bytes.get(T_GRADIENTS, 0)
+                + _FRAME.size + len(payload))
+        if self._telemetry.enabled:
+            frames, nbytes = self._m_sent[T_GRADIENTS]
+            frames.inc()
+            nbytes.inc(_FRAME.size + len(payload))
+
     def make_fabric(self) -> fabric_mod.Fabric:
         """Local fabric whose GRADIENTS sends cross the socket (the
         worker's view of the broker)."""
@@ -755,32 +801,21 @@ class WorkerBridge:
         class BridgedFabric(fabric_mod.Fabric):
             def send(self, topic, key, message):
                 if topic == fabric_mod.GRADIENTS_TOPIC:
-                    payload = serde.to_bytes(message)
-                    if bridge.trace_negotiated:
-                        # open the delta flow: this send slice is the
-                        # wire segment's source; the server's net.recv
-                        # is the first step of the arrow chain
-                        fid = bridge._tracer.new_flow_id()
-                        with bridge._tracer.span(
-                                "net.send", topic="gradients",
-                                worker=getattr(message, "worker_id", key)):
-                            bridge._tracer.flow_start("delta.wire", fid)
-                        payload += _TRACE_CTX.pack(fid, 0)
-                    locked_send(bridge._sock, bridge._send_lock,
-                                T_GRADIENTS, key, payload)
-                    with bridge._wire_lock:
-                        bridge.wire_bytes[T_GRADIENTS] = (
-                            bridge.wire_bytes.get(T_GRADIENTS, 0)
-                            + _FRAME.size + len(payload))
-                    if bridge._telemetry.enabled:
-                        frames, nbytes = bridge._m_sent[T_GRADIENTS]
-                        frames.inc()
-                        nbytes.inc(_FRAME.size + len(payload))
+                    bridge.send_gradients(key, message)
                 else:
                     super().send(topic, key, message)
 
         self.fabric = BridgedFabric()
         return self.fabric
+
+    def set_weights_sink(self, sink) -> None:
+        """Deliver received WEIGHTS frames into `sink.send(topic, key,
+        msg)` instead of a make_fabric() fabric.  A sharded worker
+        process plugs a per-shard collector here so each bridge's
+        weights SLICES feed runtime/sharding.WeightsAssembler.offer
+        and only the reassembled full-range message reaches the
+        workers' local fabric (docs/SHARDING.md)."""
+        self.fabric = sink
 
     def _apply_server_ping_interval(self, interval: float) -> None:
         """React to the server's advertised PING cadence (T_CONFIG,

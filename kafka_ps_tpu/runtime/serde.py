@@ -37,19 +37,22 @@ import numpy as np
 
 from kafka_ps_tpu.compress import wire as cwire
 from kafka_ps_tpu.runtime.messages import (GradientMessage, KeyRange,
-                                           LabeledData, WeightsMessage)
+                                           LabeledData, SparseDeltaMessage,
+                                           WeightsMessage)
 
 MAGIC = b"KPS1"
 
 # the `_t` registry (JSONSerdeCompatible.java:12-23); 4/5 are the
 # codec-compressed variants of 1/2 (binary only — JSON keeps the
-# reference-compatible three)
+# reference-compatible three); 6 is the range-sharded sparse delta
+# slice (docs/SHARDING.md — topk slices routed to the owning shard)
 _TYPE_IDS = {
     "WeightsMessage": 1,
     "GradientMessage": 2,
     "LabeledData": 3,
     "CompressedWeights": 4,
     "CompressedGradient": 5,
+    "SparseDelta": 6,
 }
 _ID_TYPES = {v: k for k, v in _TYPE_IDS.items()}
 
@@ -125,6 +128,15 @@ def to_bytes(msg) -> bytes:
         values = np.ascontiguousarray(msg.values, dtype="<f4")
         return (_HEADER.pack(MAGIC, tid, msg.vector_clock) + head
                 + values.tobytes())
+    if isinstance(msg, SparseDeltaMessage):
+        head = _RANGE.pack(msg.key_range.start, msg.key_range.end,
+                           msg.worker_id)
+        idx = np.ascontiguousarray(msg.indices, dtype="<i4")
+        vals = np.ascontiguousarray(msg.values, dtype="<f4")
+        return (_HEADER.pack(MAGIC, _TYPE_IDS["SparseDelta"],
+                             msg.vector_clock) + head
+                + struct.pack("<q", len(idx))
+                + idx.tobytes() + vals.tobytes())
     if isinstance(msg, LabeledData):
         keys = np.fromiter(msg.features.keys(), dtype="<i4",
                            count=len(msg.features))
@@ -174,6 +186,20 @@ def from_bytes(payload: bytes):
                                key_range=KeyRange(start, end),
                                values=values, encoded=enc,
                                worker_id=worker)
+    if name == "SparseDelta":
+        start, end, worker = _RANGE.unpack_from(payload, off)
+        off += _RANGE.size
+        (n,) = struct.unpack_from("<q", payload, off)
+        off += 8
+        idx = np.frombuffer(payload, dtype="<i4", offset=off,
+                            count=n).copy()
+        off += 4 * n
+        vals = np.frombuffer(payload, dtype="<f4", offset=off,
+                             count=n).copy()
+        return SparseDeltaMessage(vector_clock=clock_or_label,
+                                  key_range=KeyRange(start, end),
+                                  indices=idx, values=vals,
+                                  worker_id=worker)
     if name == "LabeledData":
         (n,) = struct.unpack_from("<q", payload, off)
         off += 8

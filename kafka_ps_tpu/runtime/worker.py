@@ -137,6 +137,12 @@ class WorkerNode:
         # must advance exactly once per clock — duplicates resend this
         # cached message instead (_redelivered_weights)
         self._last_sent = None
+        # range sharding (runtime/sharding.ShardRouter, set by the
+        # group/CLI wiring when the server side runs N>1 shards): each
+        # outgoing delta splits into per-shard slices pushed to the
+        # owning shards instead of one full-range send.  None keeps the
+        # unsharded send path — the N=1 protocol, bitwise today's.
+        self.shard_router = None
 
     def _prepare(self, msg: WeightsMessage):
         """Pre-dispatch half of an iteration, shared by the single-
@@ -214,7 +220,13 @@ class WorkerNode:
             values=delta,
             encoded=encoded,
             worker_id=self.worker_id)
-        self.fabric.send(fabric_mod.GRADIENTS_TOPIC, 0, out)
+        if self.shard_router is not None:
+            # split by key range and push each slice to its owning
+            # shard (the router also caches the slices for shard-crash
+            # redelivery, runtime/sharding.py)
+            self.shard_router.route(out)
+        else:
+            self.fabric.send(fabric_mod.GRADIENTS_TOPIC, 0, out)
         if self.compressor is not None:
             self._last_sent = (msg.vector_clock, out)
         if self.telemetry.enabled:
@@ -237,7 +249,10 @@ class WorkerNode:
         if last is None or msg.vector_clock > last[0]:
             return False
         if msg.vector_clock == last[0]:
-            self.fabric.send(fabric_mod.GRADIENTS_TOPIC, 0, last[1])
+            if self.shard_router is not None:
+                self.shard_router.route(last[1])
+            else:
+                self.fabric.send(fabric_mod.GRADIENTS_TOPIC, 0, last[1])
         return True
 
     def on_weights(self, msg: WeightsMessage) -> None:

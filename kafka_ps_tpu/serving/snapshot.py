@@ -109,3 +109,37 @@ class SnapshotRegistry:
             min_clock=clock,
             have_clock=None if self._latest is None
             else self._latest.vector_clock)
+
+
+class FrontierCutPublisher:
+    """Cross-shard consistent snapshots (range sharding, docs/SHARDING.md).
+
+    A sharded server group cannot publish per-release snapshots the way
+    one server does — shard thetas advance independently, and a reader
+    must never see a torn mix of shard states at different clocks.  A
+    publication here is a CUT: the vector of per-shard
+    (theta_slice, stable_clock) pairs read at a drive-loop quiescent
+    point, published only when the common clock frontier (the min of
+    the per-shard clocks) has ADVANCED past the last published one.
+    The concatenated slices become one full-range snapshot stamped with
+    the frontier clock, so every serving/policy.py staleness rule —
+    min_clock, max_age_s, at_clock audit reads — keeps exactly today's
+    meaning: a snapshot at clock c still guarantees every shard has
+    incorporated all rounds below c."""
+
+    def __init__(self, registry: SnapshotRegistry):
+        self.registry = registry
+        self._last_frontier = -1
+
+    def maybe_publish(self, cut, trace=None) -> Snapshot | None:
+        """`cut`: [(theta_slice, clock), ...] in shard-id order.  The
+        frontier is min(clock); publishes and returns the snapshot when
+        it advanced, else None (no torn/duplicate publications)."""
+        import numpy as np
+        frontier = min(clock for _, clock in cut)
+        if frontier <= self._last_frontier:
+            return None
+        theta = np.concatenate([np.asarray(s) for s, _ in cut])
+        snap = self.registry.publish(theta, frontier, trace=trace)
+        self._last_frontier = frontier
+        return snap

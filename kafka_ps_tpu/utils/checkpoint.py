@@ -164,6 +164,17 @@ def peek_run_id(path: str) -> int | None:
         return int(z["run_id"]) if "run_id" in z.files else None
 
 
+def shard_state_path(checkpoint: str, shard_id: int,
+                     num_shards: int) -> str:
+    """One checkpoint file per server shard (range sharding,
+    docs/SHARDING.md), derived from the job's --checkpoint path.  The
+    degenerate N=1 case keeps the plain path — an unsharded run and a
+    --shards 1 run read and write the SAME checkpoint."""
+    if num_shards == 1:
+        return checkpoint
+    return f"{checkpoint}.shard{shard_id}of{num_shards}.npz"
+
+
 def worker_state_path(checkpoint: str, worker_ids) -> str:
     """One state file per worker PROCESS (the ids it hosts), derived
     from the job's --checkpoint path so operators pass a single flag."""

@@ -26,6 +26,13 @@
 # consistency models) and that bf16 slab storage trains end-to-end
 # (docs/PERFORMANCE.md).
 #
+# `scripts/tier1.sh --shard` runs the range-sharding smoke leg: a
+# socket-bridged fleet of 2 shard-server processes + 1 worker process
+# (2 logical workers), SIGKILL one shard mid-run, restart it, and prove
+# bitwise recovery by replaying each shard's per-shard durable-log
+# gradients partition through a fresh ServerNode and comparing against
+# the shard's final checkpoint theta bytes (docs/SHARDING.md).
+#
 # `scripts/tier1.sh --analyze` runs the static-analysis leg: pscheck
 # (docs/ANALYSIS.md) over the package — fails on ANY unsuppressed
 # finding — plus ruff (pyproject.toml, rule sets E/F/B/PLE) when the
@@ -48,6 +55,159 @@ if [[ "${1:-}" == "--analyze" ]]; then
     fi
     echo ANALYZE_OK
     exit 0
+fi
+
+if [[ "${1:-}" == "--shard" ]]; then
+    timeout -k 10 540 env JAX_PLATFORMS=cpu python - <<'EOF'
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+# a real split-deployment fleet: 2 shard-server subprocesses + 1 worker
+# subprocess hosting 2 logical workers, driven through the public CLI
+root = tempfile.mkdtemp(prefix="kps-shard-")
+repo = os.getcwd()
+rng = np.random.default_rng(0)
+x = rng.normal(size=(256, 8)).astype(np.float32)
+y = (x[:, 0] > 0).astype(np.int32) + 1
+train, test = os.path.join(root, "train.csv"), os.path.join(root, "test.csv")
+for path, (xx, yy) in ((train, (x[:200], y[:200])),
+                       (test, (x[200:], y[200:]))):
+    with open(path, "w") as fh:
+        fh.write(",".join(f"f{i}" for i in range(8)) + ",Score\n")
+        for r, lab in zip(xx, yy):
+            fh.write(",".join(f"{v:.6f}" for v in r) + f",{lab}\n")
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+p0, p1 = free_port(), free_port()
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+MAX_IT = 400
+common = ["--num_workers", "2", "--num_features", "8",
+          "--num_classes", "2", "--max_iterations", str(MAX_IT)]
+logdir, ckpt = os.path.join(root, "log"), os.path.join(root, "ckpt.npz")
+
+def shard(i, port):
+    return subprocess.Popen(
+        [sys.executable, "-m", "kafka_ps_tpu.cli.server_runner",
+         "--listen", str(port), "--shards", "2", "--shard-id", str(i),
+         "-training", train, "-test", test, "-p", "5", "-c", "0",
+         "--durable-log", logdir, "--checkpoint", ckpt,
+         "--checkpoint_every", "50", *common],
+        env=env, cwd=root, stderr=subprocess.PIPE,
+        stdout=subprocess.DEVNULL, text=True)
+
+s0, s1 = shard(0, p0), shard(1, p1)
+w = subprocess.Popen(
+    [sys.executable, "-m", "kafka_ps_tpu.cli.worker_runner",
+     "--connect", f"127.0.0.1:{p0},127.0.0.1:{p1}",
+     "--worker_ids", "0,1", "-test", test,
+     "-min", "8", "-max", "32", *common],
+    env=env, cwd=root, stderr=subprocess.PIPE,
+    stdout=subprocess.DEVNULL, text=True)
+
+# wait until shard 1 has logged a prefix of gradient slices, then
+# SIGKILL it mid-run
+grad_glob = os.path.join(logdir, "shard1of2", "gradients", "*", "*.log")
+deadline = time.monotonic() + 120
+while time.monotonic() < deadline:
+    segs = glob.glob(grad_glob)
+    if segs and sum(os.path.getsize(s) for s in segs) > 8000:
+        break
+    if s1.poll() is not None:
+        print(s1.stderr.read(), file=sys.stderr)
+        raise SystemExit("shard1 exited before the kill point")
+    time.sleep(0.1)
+else:
+    raise SystemExit("shard1 gradient log never grew")
+os.kill(s1.pid, signal.SIGKILL)
+s1.wait()
+time.sleep(0.5)
+s1b = shard(1, p1)       # workers + shard0 kept running throughout
+
+procs = {"shard0": s0, "shard1-restarted": s1b, "worker": w}
+deadline = time.monotonic() + 300
+while time.monotonic() < deadline:
+    if all(p.poll() is not None for p in procs.values()):
+        break
+    time.sleep(0.5)
+else:
+    for p in procs.values():
+        if p.poll() is None:
+            p.kill()
+    for name, p in procs.items():
+        print(f"== {name} rc={p.poll()}\n{p.stderr.read()[-4000:]}",
+              file=sys.stderr)
+    raise SystemExit("fleet did not finish in time")
+bad = []
+for name, p in procs.items():
+    err = p.stderr.read()
+    if p.returncode != 0:
+        print(f"== {name} rc={p.returncode}\n{err[-4000:]}",
+              file=sys.stderr)
+        bad.append(name)
+assert not bad, f"{bad} failed"
+
+# bitwise proof: replay each shard's FULL gradients partition (offset 0
+# up to the final checkpoint's committed offset) through a fresh
+# ServerNode — log order is processing order across both incarnations,
+# and the tracker dedups redelivered slices identically — then compare
+# against the shard's final checkpoint theta bytes.
+from kafka_ps_tpu.log import LogConfig
+from kafka_ps_tpu.log.manager import LogManager
+from kafka_ps_tpu.models.task import get_task
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime import serde
+from kafka_ps_tpu.runtime.server import ServerNode
+from kafka_ps_tpu.runtime.sharding import ShardPlan
+from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig, PSConfig,
+                                       StreamConfig)
+
+cfg = PSConfig(num_workers=2, consistency_model=0, task="logreg",
+               model=ModelConfig(num_features=8, num_classes=2),
+               buffer=BufferConfig(min_size=8, max_size=32),
+               stream=StreamConfig(time_per_event_ms=5),
+               use_gang=False)
+plan = ShardPlan(get_task(cfg.task, cfg.model).num_params, 2)
+replayed = []
+for i in range(2):
+    z = np.load(os.path.join(root, f"ckpt.npz.shard{i}of2.npz"))
+    end = json.loads(str(z["log_offsets"]))["gradients/0"]
+    srv = ServerNode(cfg, fabric_mod.Fabric(), None, None, None,
+                     key_range=plan.ranges[i], shard_id=i, num_shards=2)
+    srv.start_training_loop()
+    mgr = LogManager(os.path.join(logdir, f"shard{i}of2"), LogConfig())
+    n = 0
+    for off, payload in mgr.get("gradients", 0).read_from(0):
+        if off >= end:
+            break
+        srv.process(serde.from_bytes(payload))
+        n += 1
+    mgr.close()
+    replay = np.asarray(srv.theta, dtype=np.float32)
+    want = np.asarray(z["theta"], dtype=np.float32)
+    assert srv.iterations >= MAX_IT, (i, srv.iterations)
+    assert replay.tobytes() == want.tobytes(), \
+        f"shard {i}: replayed theta diverged from final checkpoint"
+    replayed.append(n)
+print(f"SHARD_SMOKE_OK shards=2 replayed={replayed} "
+      f"iters={MAX_IT} bitwise=recovered")
+EOF
+    exit $?
 fi
 
 if [[ "${1:-}" == "--obs" ]]; then

@@ -1,0 +1,9 @@
+"""PS104 positive fixture (scoped: parallel/range_sharded.py): a
+wall-clock read in the shard_map prototype's step path — pad/unshard
+round-trips must be bitwise-reproducible."""
+import time
+
+
+def stamp_step(record):
+    record.ts = time.time()
+    return record

@@ -1,0 +1,8 @@
+"""PS104 positive fixture (scoped: runtime/sharding.py): iterating a
+bare set in a routing path makes slice send order hash-dependent —
+per-shard durable-log replay would not be bitwise."""
+
+
+def route_slices(slices_by_shard):
+    for shard_id in set(slices_by_shard):
+        yield slices_by_shard[shard_id]

@@ -1,0 +1,5 @@
+"""PS104 negative fixture: deterministic padding arithmetic only."""
+
+
+def padded_len(num_params, num_shards):
+    return num_params + (-num_params) % num_shards

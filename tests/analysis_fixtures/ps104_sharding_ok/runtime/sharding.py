@@ -1,0 +1,12 @@
+"""PS104 negative fixture: shard-id-ordered iteration and monotonic
+pacing are replay-safe in the sharding runtime."""
+import time
+
+
+def route_slices(slices_by_shard):
+    for shard_id in sorted(set(slices_by_shard)):
+        yield slices_by_shard[shard_id]
+
+
+def resend_due(last, interval):
+    return time.monotonic() - last >= interval

@@ -29,6 +29,8 @@ def _findings(relpath: str):
     ("runtime/ps102_bad.py", "PS102"),
     ("ps103/serde.py", "PS103"),
     ("log/ps104_bad.py", "PS104"),
+    ("ps104_sharding_bad/runtime/sharding.py", "PS104"),
+    ("ps104_sharding_bad/parallel/range_sharded.py", "PS104"),
     ("ps105_bad.py", "PS105"),
     ("runtime/ps106_bad.py", "PS106"),
 ])
@@ -43,6 +45,8 @@ def test_positive_fixture_triggers_exactly_once(relpath, rule):
     "runtime/ps102_ok.py",
     "ps103/net.py",
     "log/ps104_ok.py",
+    "ps104_sharding_ok/runtime/sharding.py",
+    "ps104_sharding_ok/parallel/range_sharded.py",
     "ps105_ok.py",
     "runtime/ps106_ok.py",
 ])

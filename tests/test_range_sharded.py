@@ -85,6 +85,29 @@ def test_padding_roundtrip():
         range_sharded.unshard_theta(padded, CFG), np.asarray(theta))
 
 
+def test_pad_leak_raises_at_unshard():
+    """Regression (pad-hygiene): a delta that lands in the pad region
+    appended by pad_theta must fail LOUDLY at the unshard boundary —
+    unshard_theta used to slice it off silently, hiding range leaks."""
+    theta = jnp.arange(CFG.num_params, dtype=jnp.float32)
+    padded = np.array(range_sharded.pad_theta(theta, CFG, 4))
+    assert padded.shape[0] > CFG.num_params     # 203 pads to 204
+    padded[CFG.num_params] = 0.125              # the leak
+    with pytest.raises(ValueError, match=f"key {CFG.num_params}"):
+        range_sharded.unshard_theta(padded, CFG)
+    with pytest.raises(ValueError, match="pad region"):
+        range_sharded.assert_pad_clean(padded, CFG)
+
+
+def test_pad_clean_accepts_clean_and_unpadded():
+    theta = jnp.arange(CFG.num_params, dtype=jnp.float32)
+    padded = range_sharded.pad_theta(theta, CFG, 4)
+    range_sharded.assert_pad_clean(padded, CFG)         # clean: no raise
+    range_sharded.assert_pad_clean(theta, CFG)          # pad-free: no-op
+    np.testing.assert_array_equal(
+        range_sharded.unshard_theta(padded, CFG), np.asarray(theta))
+
+
 def test_rejects_bad_mesh_and_worker_counts():
     mesh = _mesh_or_skip(2, 2)
     with pytest.raises(ValueError, match="multiple of the mesh"):

@@ -1,0 +1,505 @@
+"""Range-sharded multi-server runtime (runtime/sharding.py,
+docs/SHARDING.md).
+
+The load-bearing pins, in order of importance:
+
+  * N=1 through ShardedServerGroup is BITWISE-identical to the
+    unsharded server — final theta AND server CSV rows — for all three
+    consistency models.  This is the acceptance contract that lets the
+    sharded runtime replace the single-server path without a flag day.
+  * ShardPlan covers the key space exactly (disjoint, clipped last
+    shard, no pad keys on the wire — contrast the shard_map prototype
+    in parallel/range_sharded.py, which pads).
+  * Router/assembler redelivery: a recovering shard that redelivers an
+    old weights slice gets the bitwise-identical cached gradient tail
+    resent, never recomputed.
+  * The tid-6 SparseDelta serde frame round-trips (including the EMPTY
+    slice every gate still needs).
+  * Sharded metric families carry the `shard` label; unsharded ones
+    keep the historical label set (docs/OBSERVABILITY.md).
+"""
+
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.compress.wire import CODEC_TOPK
+from kafka_ps_tpu.data.buffer import SlidingBuffer
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime import serde
+from kafka_ps_tpu.runtime.app import StreamingPSApp
+from kafka_ps_tpu.runtime.messages import (EncodedValues, GradientMessage,
+                                           KeyRange, SparseDeltaMessage,
+                                           WeightsMessage)
+from kafka_ps_tpu.runtime.server import ServerNode
+from kafka_ps_tpu.runtime.sharding import (ShardedServerGroup, ShardPlan,
+                                           ShardRouter, WeightsAssembler)
+from kafka_ps_tpu.runtime.worker import WorkerNode
+from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig, PSConfig,
+                                       StreamConfig)
+
+
+class ListSink:
+    """Plain callable sink: rows format eagerly (utils/asynclog
+    submit_or_write), so captured strings match what a CsvLogSink
+    would have written minus the file."""
+
+    def __init__(self):
+        self.rows = []
+
+    def __call__(self, line: str) -> None:
+        self.rows.append(line)
+
+    def close(self) -> None:
+        pass
+
+
+def _cfg(consistency: int, num_workers: int = 4) -> PSConfig:
+    return PSConfig(num_workers=num_workers, consistency_model=consistency,
+                    model=ModelConfig(num_features=8, num_classes=2,
+                                      local_learning_rate=0.5),
+                    buffer=BufferConfig(min_size=8, max_size=32),
+                    stream=StreamConfig(time_per_event_ms=1.0),
+                    use_gang=False)
+
+
+def _data(n: int = 128, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32) + 1
+    return x, y
+
+
+# -- ShardPlan -------------------------------------------------------------
+
+@pytest.mark.parametrize("num_params,num_shards", [
+    (10, 1), (10, 2), (10, 3), (10, 4), (10, 10), (6150, 4), (203, 8)])
+def test_plan_covers_key_space_exactly(num_params, num_shards):
+    plan = ShardPlan(num_params, num_shards)
+    assert len(plan.ranges) == num_shards
+    # contiguous, disjoint, covering: ranges concatenate to [0, P)
+    assert plan.ranges[0].start == 0
+    assert plan.ranges[-1].end == num_params
+    for a, b in zip(plan.ranges, plan.ranges[1:]):
+        assert a.end == b.start
+    # every key has exactly one owner, consistent with the ranges
+    for key in range(num_params):
+        owner = plan.shard_of(key)
+        assert plan.ranges[owner].contains(key)
+    # no pad: total span of the ranges is exactly num_params
+    assert sum(len(r) for r in plan.ranges) == num_params
+
+
+def test_plan_rejects_bad_shapes():
+    with pytest.raises(ValueError, match="num_shards"):
+        ShardPlan(10, 0)
+    with pytest.raises(ValueError, match="num_shards"):
+        ShardPlan(3, 4)
+    plan = ShardPlan(10, 2)
+    with pytest.raises(ValueError, match="outside"):
+        plan.shard_of(10)
+    with pytest.raises(ValueError, match="outside"):
+        plan.shard_of(-1)
+
+
+def test_split_dense_reassembles_bitwise():
+    plan = ShardPlan(11, 3)         # spans 4,4,3 — clipped last shard
+    values = np.arange(11, dtype=np.float32) * 0.5
+    msg = GradientMessage(vector_clock=7, key_range=KeyRange(0, 11),
+                          values=values, worker_id=2)
+    slices = plan.split_dense(msg)
+    assert [s.key_range for s in slices] == list(plan.ranges)
+    for s in slices:
+        assert s.vector_clock == 7 and s.worker_id == 2
+        assert len(s.values) == len(s.key_range)
+    back = np.concatenate([np.asarray(s.values) for s in slices])
+    assert back.tobytes() == values.tobytes()
+
+
+def test_split_sparse_routes_by_range_with_local_offsets():
+    plan = ShardPlan(10, 3)         # ranges [0,4) [4,8) [8,10)
+    idx = np.array([9, 1, 5, 3], dtype=np.int32)      # deliberately unsorted
+    vals = np.array([9.0, 1.0, 5.0, 3.0], dtype=np.float32)
+    full = np.zeros(10, dtype=np.float32)
+    msg = GradientMessage(
+        vector_clock=3, key_range=KeyRange(0, 10), values=full, worker_id=1,
+        encoded=EncodedValues(CODEC_TOPK, 0.4, (idx, vals)))
+    slices = plan.split_sparse(msg)
+    assert [s.key_range for s in slices] == list(plan.ranges)
+    # shard 0 owns global keys 1,3 -> local offsets 1,3 (sorted)
+    np.testing.assert_array_equal(slices[0].indices, [1, 3])
+    np.testing.assert_array_equal(slices[0].values, [1.0, 3.0])
+    # shard 1 owns global key 5 -> local offset 1
+    np.testing.assert_array_equal(slices[1].indices, [1])
+    np.testing.assert_array_equal(slices[1].values, [5.0])
+    # shard 2 owns global key 9 -> local offset 1
+    np.testing.assert_array_equal(slices[2].indices, [1])
+    np.testing.assert_array_equal(slices[2].values, [9.0])
+    for s in slices:
+        assert s.indices.dtype == np.int32
+        assert s.vector_clock == 3 and s.worker_id == 1
+
+
+def test_split_sparse_empty_slices_still_carry_protocol_fields():
+    """A shard outside the survivor set still gets a (worker, clock)
+    message — its gate needs it; only the apply is skipped."""
+    plan = ShardPlan(12, 4)
+    idx = np.array([0, 1], dtype=np.int32)            # all in shard 0
+    vals = np.array([0.5, -0.5], dtype=np.float32)
+    msg = GradientMessage(
+        vector_clock=11, key_range=KeyRange(0, 12),
+        values=np.zeros(12, dtype=np.float32), worker_id=3,
+        encoded=EncodedValues(CODEC_TOPK, 0.2, (idx, vals)))
+    slices = plan.split_sparse(msg)
+    assert len(slices[0].indices) == 2
+    for s in slices[1:]:
+        assert len(s.indices) == 0 and len(s.values) == 0
+        assert s.vector_clock == 11 and s.worker_id == 3
+
+
+def test_routed_slices_keep_delta_wire_trace():
+    """Flow-event threading (satellite of docs/OBSERVABILITY.md): each
+    routed slice inherits the parent delta's trace id so the delta.wire
+    arrow chain stays connected through the shard hop."""
+    plan = ShardPlan(10, 2)
+    msg = GradientMessage(vector_clock=0, key_range=KeyRange(0, 10),
+                          values=np.zeros(10, dtype=np.float32))
+    object.__setattr__(msg, "trace", 424242)
+    for s in plan.split_dense(msg):
+        assert getattr(s, "trace", None) == 424242
+    sparse = GradientMessage(
+        vector_clock=0, key_range=KeyRange(0, 10),
+        values=np.zeros(10, dtype=np.float32),
+        encoded=EncodedValues(CODEC_TOPK, 0.1, (
+            np.array([2], dtype=np.int32),
+            np.array([1.0], dtype=np.float32))))
+    object.__setattr__(sparse, "trace", 424242)
+    for s in plan.split_sparse(sparse):
+        assert getattr(s, "trace", None) == 424242
+
+
+# -- tid-6 serde -----------------------------------------------------------
+
+def test_sparse_delta_serde_roundtrip():
+    msg = SparseDeltaMessage(
+        vector_clock=17, key_range=KeyRange(100, 228),
+        indices=np.array([0, 5, 127], dtype=np.int32),
+        values=np.array([1.5, -2.25, 0.125], dtype=np.float32),
+        worker_id=3)
+    out = serde.from_bytes(serde.to_bytes(msg))
+    assert isinstance(out, SparseDeltaMessage)
+    assert out.vector_clock == 17 and out.worker_id == 3
+    assert (out.key_range.start, out.key_range.end) == (100, 228)
+    assert out.indices.dtype == np.int32
+    assert out.values.dtype == np.float32
+    assert out.indices.tobytes() == msg.indices.tobytes()
+    assert out.values.tobytes() == msg.values.tobytes()
+
+
+def test_sparse_delta_serde_empty_slice_is_tiny():
+    """The empty slice is pure gate bookkeeping — its frame must stay
+    tens of bytes, or sharding would inflate wire traffic N-fold."""
+    msg = SparseDeltaMessage(
+        vector_clock=2, key_range=KeyRange(8, 16),
+        indices=np.empty(0, dtype=np.int32),
+        values=np.empty(0, dtype=np.float32), worker_id=0)
+    frame = serde.to_bytes(msg)
+    assert len(frame) < 100
+    out = serde.from_bytes(frame)
+    assert isinstance(out, SparseDeltaMessage)
+    assert len(out.indices) == 0 and len(out.values) == 0
+    assert (out.key_range.start, out.key_range.end) == (8, 16)
+
+
+# -- sparse apply on a shard -----------------------------------------------
+
+def test_sparse_apply_matches_dense_slice():
+    """theta.at[idx].add on a shard slice must equal the dense add of
+    the equivalent scattered slab (same values, same order)."""
+    cfg = _cfg(0, num_workers=1)
+    plan = ShardPlan(ModelConfig(num_features=8, num_classes=2).num_params,
+                     2)
+    rng = plan.ranges[1]
+    idx = np.array([0, 3, len(rng) - 1], dtype=np.int32)
+    vals = np.array([0.5, -1.5, 2.0], dtype=np.float32)
+    dense = np.zeros(len(rng), dtype=np.float32)
+    dense[idx] = vals
+
+    def shard_node():
+        node = ServerNode(cfg, fabric_mod.Fabric(), None, None, None,
+                          key_range=rng, shard_id=1, num_shards=2)
+        node.start_training_loop()
+        return node
+
+    a = shard_node()
+    a.process(SparseDeltaMessage(vector_clock=0, key_range=rng,
+                                 indices=idx, values=vals, worker_id=0))
+    b = shard_node()
+    b.process(GradientMessage(vector_clock=0, key_range=rng,
+                              values=dense, worker_id=0))
+    assert a.iterations == b.iterations == 1
+    np.testing.assert_array_equal(np.asarray(a.theta), np.asarray(b.theta))
+
+
+def test_empty_sparse_slice_advances_gate_without_apply():
+    cfg = _cfg(0, num_workers=1)
+    plan = ShardPlan(ModelConfig(num_features=8, num_classes=2).num_params,
+                     2)
+    rng = plan.ranges[0]
+    node = ServerNode(cfg, fabric_mod.Fabric(), None, None, None,
+                      key_range=rng, shard_id=0, num_shards=2)
+    node.start_training_loop()
+    before = np.asarray(node.theta).copy()
+    node.process(SparseDeltaMessage(
+        vector_clock=0, key_range=rng,
+        indices=np.empty(0, dtype=np.int32),
+        values=np.empty(0, dtype=np.float32), worker_id=0))
+    assert node.iterations == 1                       # gate advanced
+    assert node.tracker.tracker[0].vector_clock == 1
+    np.testing.assert_array_equal(np.asarray(node.theta), before)
+
+
+# -- router / assembler redelivery -----------------------------------------
+
+def test_router_caches_and_resends_bitwise_tail():
+    plan = ShardPlan(8, 2)
+    sent = []
+    router = ShardRouter(plan, send=lambda sid, m: sent.append((sid, m)),
+                         cache_clocks=4)
+    originals = {}
+    for clock in range(6):
+        msg = GradientMessage(
+            vector_clock=clock, key_range=KeyRange(0, 8),
+            values=np.full(8, float(clock), dtype=np.float32), worker_id=0)
+        router.route(msg)
+        originals[clock] = msg
+    assert len(sent) == 12                            # 6 clocks x 2 shards
+    sent.clear()
+    # cache holds the last 4 clocks (2..5); resend from clock 3 replays
+    # the cached tail 3,4,5 for that shard only, ascending, bitwise
+    assert router.resend(1, 3) is True
+    assert [(sid, m.vector_clock) for sid, m in sent] == [
+        (1, 3), (1, 4), (1, 5)]
+    for sid, m in sent:
+        assert m.key_range == plan.ranges[1]
+        assert np.asarray(m.values).tobytes() == np.asarray(
+            originals[m.vector_clock].values)[4:8].tobytes()
+    sent.clear()
+    assert router.resend(0, 99) is False              # nothing cached >= 99
+    assert router.resend(0, 0) is True                # 0,1 evicted: 2..5 go
+    assert [m.vector_clock for _, m in sent] == [2, 3, 4, 5]
+
+
+def test_router_rejects_partial_range_delta():
+    plan = ShardPlan(8, 2)
+    router = ShardRouter(plan, send=lambda sid, m: None)
+    with pytest.raises(ValueError, match="full-range"):
+        router.route(GradientMessage(
+            vector_clock=0, key_range=KeyRange(0, 4),
+            values=np.zeros(4, dtype=np.float32)))
+
+
+def test_assembler_waits_for_common_clock_then_delivers_once():
+    plan = ShardPlan(6, 2)
+    delivered = []
+    asm = WeightsAssembler(plan,
+                           deliver=lambda w, m: delivered.append((w, m)))
+
+    def slice_msg(shard, clock):
+        r = plan.ranges[shard]
+        return WeightsMessage(vector_clock=clock, key_range=r,
+                              values=np.full(len(r), float(10 * clock +
+                                                           shard),
+                                             dtype=np.float32))
+
+    assert asm.offer(0, worker=1, msg=slice_msg(0, 0)) is False
+    assert delivered == []
+    assert asm.offer(1, worker=1, msg=slice_msg(1, 0)) is True
+    (w, full), = delivered
+    assert w == 1 and full.vector_clock == 0
+    assert (full.key_range.start, full.key_range.end) == (0, 6)
+    np.testing.assert_array_equal(
+        np.asarray(full.values),
+        np.concatenate([np.full(3, 0.0, np.float32),
+                        np.full(3, 1.0, np.float32)]))
+    # mixed clocks: shard 0 at clock 2, shard 1 still at 1 — hold
+    delivered.clear()
+    assert asm.offer(0, worker=1, msg=slice_msg(0, 2)) is False
+    assert asm.offer(1, worker=1, msg=slice_msg(1, 1)) is False
+    assert delivered == []
+    # shard 1 catches up to 2 -> assembly completes at the common clock
+    assert asm.offer(1, worker=1, msg=slice_msg(1, 2)) is True
+    assert delivered[0][1].vector_clock == 2
+
+
+def test_assembler_stale_slice_triggers_router_resend():
+    plan = ShardPlan(6, 2)
+    resends = []
+    asm = WeightsAssembler(plan, deliver=lambda w, m: None,
+                           resend=lambda sid, w, c:
+                           resends.append((sid, w, c)) or True)
+
+    def slice_msg(shard, clock):
+        r = plan.ranges[shard]
+        return WeightsMessage(vector_clock=clock, key_range=r,
+                              values=np.zeros(len(r), dtype=np.float32))
+
+    asm.offer(0, worker=0, msg=slice_msg(0, 3))
+    asm.offer(1, worker=0, msg=slice_msg(1, 3))       # delivered at 3
+    # a recovering shard redelivers clock 3: stale -> resend, no delivery
+    assert asm.offer(1, worker=0, msg=slice_msg(1, 3)) is False
+    assert resends == [(1, 0, 3)]
+    # drop() forgets partial state without touching delivered clocks
+    asm.offer(0, worker=0, msg=slice_msg(0, 4))
+    asm.drop(0)
+    assert asm.offer(1, worker=0, msg=slice_msg(1, 4)) is False
+
+
+# -- N=1 bitwise contract (the acceptance pin) -----------------------------
+
+@pytest.mark.parametrize("consistency", [0, 2, -1],
+                         ids=["sequential", "bounded", "eventual"])
+def test_n1_group_bitwise_theta_and_csv_vs_unsharded(consistency):
+    """ShardedServerGroup at N=1 must be indistinguishable from the
+    unsharded server: identical final theta BYTES and identical server
+    CSV rows (timestamp column excluded) for every consistency model."""
+    iters = 24
+    sx, sy = _data()
+
+    base_sink = ListSink()
+    app = StreamingPSApp(_cfg(consistency), test_x=sx, test_y=sy,
+                         server_log=base_sink)
+    for i in range(128):
+        app.buffers[i % 4].add(dict(enumerate(sx[i])), int(sy[i]))
+    app.run_serial(iters)
+    base_theta = np.asarray(app.server.theta)
+
+    cfg = _cfg(consistency)
+    fab = fabric_mod.Fabric()
+    group_sink = ListSink()
+    group = ShardedServerGroup(cfg, fab, 1, test_x=sx, test_y=sy,
+                               log=group_sink)
+    buffers = {w: SlidingBuffer(8, cfg.buffer) for w in range(4)}
+    nodes = [WorkerNode(w, cfg, fab, buffers[w], sx, sy, ListSink())
+             for w in range(4)]
+    for i in range(128):
+        buffers[i % 4].add(dict(enumerate(sx[i])), int(sy[i]))
+    group.run_serial(nodes, iters)
+
+    assert group.assembled_theta().tobytes() == base_theta.tobytes()
+    # CSV rows: timestamp;partition;vectorClock;loss;fMeasure;accuracy —
+    # everything after the wall-clock stamp must match field-for-field
+    strip = lambda rows: [r.split(";")[1:] for r in rows]
+    assert strip(group_sink.rows) == strip(base_sink.rows)
+    assert len(group_sink.rows) > 0
+
+
+def test_n2_dense_group_matches_n1_theta():
+    """Dense splitting is value-preserving: each shard applies exactly
+    its contiguous slice of the same delta, so the assembled N=2 theta
+    equals the N=1 theta bitwise (elementwise adds on disjoint ranges)."""
+    iters = 24
+    sx, sy = _data()
+    thetas = {}
+    for n in (1, 2):
+        cfg = _cfg(0)
+        fab = fabric_mod.Fabric()
+        group = ShardedServerGroup(cfg, fab, n)
+        buffers = {w: SlidingBuffer(8, cfg.buffer) for w in range(4)}
+        nodes = [WorkerNode(w, cfg, fab, buffers[w], sx, sy, ListSink())
+                 for w in range(4)]
+        for i in range(128):
+            buffers[i % 4].add(dict(enumerate(sx[i])), int(sy[i]))
+        group.run_serial(nodes, iters)
+        thetas[n] = group.assembled_theta()
+        assert group.iterations >= iters
+        assert group.frontier_clock() >= 0
+    assert thetas[2].tobytes() == thetas[1].tobytes()
+
+
+# -- telemetry shard labels ------------------------------------------------
+
+def test_sharded_metric_families_carry_shard_label():
+    from kafka_ps_tpu.telemetry.registry import Telemetry
+    tel = Telemetry()
+    ShardedServerGroup(_cfg(0), fabric_mod.Fabric(), 2, telemetry=tel)
+    snap = tel.snapshot()
+    for fam in ("gate_wait_ms", "clock_lag", "worker_clock_lag",
+                "gradients_applied_total", "snapshots_published_total",
+                "serving_clock"):
+        labels = set(snap[fam])
+        assert any("shard=0" in k for k in labels), (fam, labels)
+        assert any("shard=1" in k for k in labels), (fam, labels)
+    # unsharded keeps the historical label set: NO shard label anywhere
+    tel1 = Telemetry()
+    ShardedServerGroup(_cfg(0), fabric_mod.Fabric(), 1, telemetry=tel1)
+    snap1 = tel1.snapshot()
+    for fam, entry in snap1.items():
+        assert not any("shard=" in k for k in entry), (fam, entry)
+
+
+# -- frontier cuts / serving -----------------------------------------------
+
+class _Registry:
+    def __init__(self):
+        self.published = []
+
+    def publish(self, theta, clock, trace=None):
+        self.published.append((np.asarray(theta).copy(), clock))
+        return self.published[-1]
+
+
+def test_frontier_cut_publisher_only_advances():
+    from kafka_ps_tpu.serving.snapshot import FrontierCutPublisher
+    reg = _Registry()
+    pub = FrontierCutPublisher(reg)
+    a = np.arange(3, dtype=np.float32)
+    b = np.arange(3, 6, dtype=np.float32)
+    assert pub.maybe_publish([(a, 3), (b, 5)]) is not None
+    theta, clock = reg.published[0]
+    assert clock == 3                                 # frontier = min
+    np.testing.assert_array_equal(theta, np.arange(6, dtype=np.float32))
+    # same frontier again: torn/duplicate publication suppressed
+    assert pub.maybe_publish([(a, 3), (b, 6)]) is None
+    assert len(reg.published) == 1
+    # frontier advanced: publish
+    assert pub.maybe_publish([(a, 4), (b, 6)]) is not None
+    assert reg.published[-1][1] == 4
+
+
+# -- per-shard checkpointing -----------------------------------------------
+
+def test_group_checkpoint_roundtrip(tmp_path):
+    from kafka_ps_tpu.utils import checkpoint as ckpt
+    sx, sy = _data()
+    ckpt_path = str(tmp_path / "state.npz")
+
+    def run_group():
+        cfg = _cfg(0)
+        fab = fabric_mod.Fabric()
+        group = ShardedServerGroup(cfg, fab, 2)
+        group.set_checkpoint(ckpt_path, every=1000)   # manual saves only
+        buffers = {w: SlidingBuffer(8, cfg.buffer) for w in range(4)}
+        nodes = [WorkerNode(w, cfg, fab, buffers[w], sx, sy, ListSink())
+                 for w in range(4)]
+        for i in range(128):
+            buffers[i % 4].add(dict(enumerate(sx[i])), int(sy[i]))
+        group.run_serial(nodes, 12)
+        return group
+
+    group = run_group()
+    theta = group.assembled_theta()
+    cut = group.snapshot_cut()
+    assert len(cut) == 2
+    assert np.concatenate([s for s, _ in cut]).tobytes() == theta.tobytes()
+    group.save_checkpoint_now()
+    for i in range(2):
+        assert (tmp_path / ckpt.shard_state_path(
+            "state.npz", i, 2)).exists()
+
+    restored = ShardedServerGroup(_cfg(0), fabric_mod.Fabric(), 2)
+    restored.set_checkpoint(ckpt_path, every=1000)
+    assert restored.maybe_restore() is True
+    assert restored.assembled_theta().tobytes() == theta.tobytes()
+    for orig, rest in zip(group.shards, restored.shards):
+        assert rest.tracker.tracker[0].vector_clock == \
+            orig.tracker.tracker[0].vector_clock
