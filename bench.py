@@ -53,6 +53,27 @@ import time
 
 import numpy as np
 
+# Every block published under detail.paths in bench_out.json.  The
+# end-of-run self-check and tests/test_bench_contract.py both assert
+# against this list, so adding a block here without emitting it (or
+# vice versa) fails loudly instead of drifting the schema.
+KNOWN_BLOCKS = (
+    "fused_mlp_rounds_per_sec",
+    "mlp4096_full_runtime",
+    "pallas_ab",
+    "pallas_ab_mlp",
+    "per_node_iters_per_sec_eval_every_1",
+    "per_node_iters_per_sec_eval_every_10",
+    "gang_ab",
+    "serving_ab",
+    "serving_load",
+    "compression_ab",
+    "sharding_ab",
+    "slab_ab",
+    "telemetry_overhead",
+    "staleness",
+)
+
 
 def rate_stats(rates: list[float], round_to: int = 1) -> dict:
     """{median, iqr, trials} for a list of per-trial rates — the
@@ -273,6 +294,114 @@ def serving_ab(theta, cfg, trials: int = 3, threads: int = 4,
         "batching_speedup": round(
             batched["predictions_per_sec"]["median"]
             / max(unbatched["predictions_per_sec"]["median"], 1e-9), 3),
+    }
+
+
+def serving_load(theta, cfg, *, deadline_ms: float = 50.0,
+                 probe_s: float = 0.5, fleet_per_replica: int = 8,
+                 flash_crowd: int = 96) -> dict:
+    """Serving knee + overload behaviour (docs/SERVING.md, "Operating
+    at load"): open-loop load against admission-controlled engines.
+
+    Two client models, because "overload" means different things:
+
+      * fleet: a bounded pool of `fleet_per_replica` synchronous thin
+        clients PER replica endpoint (the PredictClient contract — one
+        outstanding request per connection).  The knee is found per
+        topology; connections scale with replicas exactly as a k8s
+        Service adds endpoints (deploy/k8s/replica.yaml + HPA), so
+        knee(2 replicas)/knee(1) is the replica scaling factor.
+      * flash crowd: `flash_crowd` connections on ONE engine.  A
+        synchronous fleet self-throttles at its own size, so true
+        admission pressure needs in-flight > queue_limit; at 2x this
+        model's knee the engine must shed EXPLICITLY (typed
+        OverloadedError, shed_rate > 0) while accepted-request p99
+        stays inside the deadline — queueing-to-death is the failure
+        mode admission control exists to prevent.
+
+    A socket-path run (real ServerBridge + PredictClient wire frames)
+    rides along so the in-process numbers can't silently diverge from
+    what a remote client sees."""
+    from kafka_ps_tpu.models.task import get_task
+    from kafka_ps_tpu.runtime import net
+    from kafka_ps_tpu.serving import loadgen
+    from kafka_ps_tpu.serving.engine import PredictionEngine
+    from kafka_ps_tpu.serving.snapshot import SnapshotRegistry
+
+    task = get_task("logreg", cfg)
+
+    def make_engine():
+        registry = SnapshotRegistry()
+        registry.publish(theta, vector_clock=1)
+        eng = PredictionEngine(task, registry, queue_limit=32,
+                               shed_deadline_s=deadline_ms / 1000.0)
+        eng.warmup()
+        return eng
+
+    def knee(n_replicas: int, concurrency: int) -> dict:
+        engines = [make_engine() for _ in range(n_replicas)]
+        target = loadgen.RoundRobinTarget(
+            [loadgen.EngineTarget(e) for e in engines])
+        try:
+            def run_at(rate):
+                return loadgen.run_open_loop(
+                    target, cfg.num_features, rate_qps=rate,
+                    duration_s=probe_s, concurrency=concurrency)
+            return loadgen.find_knee(run_at, deadline_ms,
+                                     lo_qps=200.0, bisect_steps=3)
+        finally:
+            for e in engines:
+                e.close()
+
+    single = knee(1, fleet_per_replica)
+    dual = knee(2, 2 * fleet_per_replica)
+    crowd = knee(1, flash_crowd)
+
+    # 2x overload on the flash-crowd model: explicit sheds, accepted
+    # requests still fast — plus the same rate arriving bursty (the
+    # flash-crowd shape the admission queue exists for)
+    eng = make_engine()
+    target = loadgen.EngineTarget(eng)
+    try:
+        rate = max(2.0 * crowd["knee_qps"], 1000.0)
+        overload = loadgen.run_open_loop(
+            target, cfg.num_features, rate_qps=rate,
+            duration_s=2 * probe_s, concurrency=flash_crowd).as_dict()
+        bursty = loadgen.run_open_loop(
+            target, cfg.num_features, rate_qps=rate / 2,
+            duration_s=2 * probe_s, concurrency=flash_crowd,
+            arrivals="bursty").as_dict()
+    finally:
+        eng.close()
+
+    # socket path: same engine behind a real serving port
+    eng = make_engine()
+    bridge = net.ServerBridge(port=0, run_id=1)
+    bridge.attach_serving(eng)
+    sock_target = loadgen.SocketTarget("127.0.0.1", bridge.port)
+    try:
+        socket_run = loadgen.run_closed_loop(
+            sock_target, cfg.num_features,
+            concurrency=fleet_per_replica,
+            duration_s=2 * probe_s).as_dict()
+    finally:
+        sock_target.close()
+        bridge.close()
+        eng.close()
+
+    scaling = round(dual["knee_qps"] / max(single["knee_qps"], 1e-9), 2)
+    return {
+        "deadline_ms": deadline_ms,
+        "queue_limit": 32,
+        "fleet_per_replica": fleet_per_replica,
+        "flash_crowd": flash_crowd,
+        "single": single,
+        "two_replicas": dual,
+        "replica_scaling": scaling,
+        "flash_crowd_knee": crowd,
+        "overload_2x": overload,
+        "overload_bursty": bursty,
+        "socket_closed_loop": socket_run,
     }
 
 
@@ -1074,6 +1203,9 @@ def main() -> None:
     # -- serving plane A/B (docs/SERVING.md) -------------------------------
     serving = serving_ab(theta, cfg, trials=3)
 
+    # -- serving knee + admission control under load -----------------------
+    load = serving_load(theta, cfg)
+
     # -- compressed delta transport A/B (docs/COMPRESSION.md) --------------
     compression = compression_ab()
 
@@ -1128,6 +1260,7 @@ def main() -> None:
                 "per_node_iters_per_sec_eval_every_10": per_node_eval10,
                 "gang_ab": gang_ab,
                 "serving_ab": serving,
+                "serving_load": load,
                 "compression_ab": compression,
                 "sharding_ab": sharding,
                 "slab_ab": slab,
@@ -1181,6 +1314,11 @@ def main() -> None:
             "serving_dispatches_per_request": d["paths"]["serving_ab"][
                 "batched"]["dispatches_per_request"],
             "serving_p50_ms": d["paths"]["serving_ab"]["batched"]["p50_ms"],
+            "serving_knee_qps": load["single"]["knee_qps"],
+            "serving_knee_qps_2replica": load["two_replicas"]["knee_qps"],
+            "serving_replica_scaling": load["replica_scaling"],
+            "serving_shed_rate_2x": load["overload_2x"]["shed_rate"],
+            "serving_accepted_p99_2x": load["overload_2x"]["p99_ms"],
             "compress_int8_wire_ratio": compression["int8_wire_ratio_min"],
             "compress_int8_acc_delta": compression["int8_acc_delta_max"],
             "compress_topk_wire_ratio": compression[
@@ -1208,6 +1346,12 @@ def main() -> None:
     with open("bench_out.json") as fh:
         reread = json.load(fh)
     assert reread["metric"] == payload["metric"], "bench_out.json torn"
+    # schema-drift gate: every published block must be present in the
+    # document ON DISK (tests/test_bench_contract.py loads the committed
+    # file against the same list) — a refactor that drops a block fails
+    # here, not in whoever consumes bench_out.json next
+    missing = [b for b in KNOWN_BLOCKS if b not in reread["detail"]["paths"]]
+    assert not missing, f"bench_out.json missing blocks: {missing}"
     json.loads(summary_line)
     assert "\n" not in summary_line, "summary must be a single line"
     assert len(summary_line) < 1900, (

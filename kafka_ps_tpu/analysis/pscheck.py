@@ -84,6 +84,10 @@ HANDLER_NAMES = frozenset({
     "submit", "_dispatch", "_serve",
     "_send", "_send_raw", "_send_weights_prepared", "send_weights",
     "_weights_message", "_reader", "run_reader", "publish_snapshot",
+    # serving/loadgen.py: the per-request driver path — a host sync
+    # here is charged to every request the generator issues, skewing
+    # the very latency the harness measures
+    "_issue", "_drive", "settle", "make_issue",
 })
 
 # PS102 host-sync markers

@@ -196,6 +196,18 @@ def build_parser(include_server_flags: bool = True,
                         "micro-batch to fill")
     p.add_argument("--serve_snapshots", type=int, default=8,
                    help="snapshot ring capacity (exact-clock audit reads)")
+    p.add_argument("--serve-queue", dest="serve_queue", type=int, default=0,
+                   metavar="N",
+                   help="admission control: max outstanding admitted "
+                        "requests PER MODEL before the engine sheds with "
+                        "a typed Overloaded rejection (0 = unbounded, "
+                        "the pre-admission-control behaviour)")
+    p.add_argument("--serve-shed", dest="serve_shed_ms", type=float,
+                   default=0.0, metavar="MS",
+                   help="predictive shedding: reject a request whose "
+                        "estimated queueing delay (EWMA batch service "
+                        "time x queued batches) exceeds MS milliseconds "
+                        "(0 = off)")
     return p
 
 
@@ -247,7 +259,9 @@ def make_app_from_args(args, resuming: bool = False,
             port=getattr(args, "serve_port", None),
             max_batch=getattr(args, "serve_batch", 16),
             deadline_ms=getattr(args, "serve_deadline_ms", 2.0),
-            ring_capacity=getattr(args, "serve_snapshots", 8)),
+            ring_capacity=getattr(args, "serve_snapshots", 8),
+            queue_limit=getattr(args, "serve_queue", 0),
+            shed_deadline_ms=getattr(args, "serve_shed_ms", 0.0)),
     )
     test_x, test_y = load_test_csv(args.test_data_file_path,
                                    args.num_features)

@@ -42,6 +42,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--shard-id", dest="shard_id", type=int, default=0, metavar="I",
         help="--shards: this process's shard index in [0, N) — shard 0 "
              "additionally hosts the stream producer")
+    parser.add_argument(
+        "--serve-replica", dest="serve_replica", action="store_true",
+        help="read-replica serving process (docs/SERVING.md): follow "
+             "--durable-log DIR strictly read-only and answer T_PREDICT "
+             "frames on --serve_port, never joining the training "
+             "fabric.  Works against a live single-server log or a "
+             "--shards N deployment's per-shard logs (the replica "
+             "assembles the full-range theta stamped with the frontier "
+             "clock).  Scale reads by running more of these "
+             "(deploy/k8s/replica.yaml)")
     return parser
 
 
@@ -59,6 +69,14 @@ def main(argv=None) -> int:
                          "server process per port, docs/SHARDING.md); "
                          "in-process sharding is the "
                          "runtime.sharding.ShardedServerGroup API")
+    if getattr(args, "serve_replica", False):
+        if args.listen is not None:
+            raise SystemExit("--serve-replica is a standalone serving "
+                             "process; drop --listen (the replica only "
+                             "follows --durable-log, it never hosts the "
+                             "training fabric)")
+        from kafka_ps_tpu.cli import socket_mode
+        return socket_mode.run_replica(args)
     if args.listen is not None:
         if args.shards > 1:
             # sharded split mode OWNS a durable-log story: one commit-

@@ -256,10 +256,13 @@ def run_server(args) -> int:
         registry = SnapshotRegistry(
             capacity=getattr(args, "serve_snapshots", 8))
         server.serving = registry
+        shed_ms = getattr(args, "serve_shed_ms", 0.0)
         engine = PredictionEngine(
             server.task, registry,
             max_batch=getattr(args, "serve_batch", 16),
             deadline_s=getattr(args, "serve_deadline_ms", 2.0) / 1000.0,
+            queue_limit=getattr(args, "serve_queue", 0),
+            shed_deadline_s=shed_ms / 1000.0 if shed_ms else None,
             tracer=tracer, telemetry=telemetry)
         bridge.attach_serving(engine)
         server.publish_snapshot()    # cold start: restored/fresh theta
@@ -1051,4 +1054,79 @@ def _run_worker_sharded(args, addrs: list[str]) -> int:
         os._exit(rc)
     if errors:
         raise RuntimeError("worker failed") from errors[0]
+    return 0
+
+
+# -- log-following read replicas (docs/SERVING.md) ---------------------------
+
+def run_replica(args) -> int:
+    """Read-replica serving process: follow `--durable-log DIR` and
+    answer T_PREDICT frames, never touching the training deployment.
+
+    The replica tails the log strictly read-only (log/tail.py), so it
+    can run against a LIVE training process's directory: read load
+    scales by starting more of these, and training is provably
+    unperturbed (scripts/tier1.sh --load asserts bitwise-identical
+    theta with and without replica traffic).  For a `--shards N`
+    deployment the replica assembles per-shard slices through
+    FrontierCutPublisher and serves the full-range theta stamped with
+    the frontier clock — the serving story the live sharded runtime
+    itself does not offer (run_server_shard rejects --serve).
+    """
+    from kafka_ps_tpu.models.task import get_task
+    from kafka_ps_tpu.serving.engine import PredictionEngine
+    from kafka_ps_tpu.serving.replica import ReplicaFollower
+    from kafka_ps_tpu.serving.snapshot import SnapshotRegistry
+
+    root = getattr(args, "durable_log", None)
+    if not root:
+        raise SystemExit("--serve-replica requires --durable-log DIR "
+                         "(the training deployment's commit log to "
+                         "follow)")
+    cfg = _make_cfg(args)
+    tracer, telemetry = _make_telemetry(args)
+    task = get_task(cfg.task, cfg.model)
+    registry = SnapshotRegistry(
+        capacity=getattr(args, "serve_snapshots", 8))
+    follower = ReplicaFollower(root, registry, tracer=tracer)
+    shed_ms = getattr(args, "serve_shed_ms", 0.0)
+    engine = PredictionEngine(
+        task, registry,
+        max_batch=getattr(args, "serve_batch", 16),
+        deadline_s=getattr(args, "serve_deadline_ms", 2.0) / 1000.0,
+        queue_limit=getattr(args, "serve_queue", 0),
+        shed_deadline_s=shed_ms / 1000.0 if shed_ms else None,
+        tracer=tracer, telemetry=telemetry)
+    follower.catch_up()              # cold start: serve what's logged
+    port = getattr(args, "serve_port", None)
+    bridge = net.ServerBridge(port=0 if port is None else port,
+                              run_id=time.time_ns(), tracer=tracer,
+                              telemetry=telemetry)
+    bridge.attach_serving(engine)
+    follower.start()
+    mode = (f"{follower.num_shards}-shard assembled"
+            if follower.num_shards else "single-server")
+    print(f"replica serving on port {bridge.port} "
+          f"({mode} log {root}, clock {follower.clock})",
+          file=sys.stderr, flush=True)
+    if engine.warmup():
+        print(f"replica warm at clock {follower.clock}",
+              file=sys.stderr, flush=True)
+    try:
+        # serve until killed — a replica has no natural end of run;
+        # deployment manifests (deploy/k8s/replica.yaml) scale and
+        # reap these processes
+        duration = getattr(args, "replica_duration", None)
+        if duration:
+            time.sleep(float(duration))
+        else:
+            while True:
+                time.sleep(3600.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        follower.stop()
+        engine.close()
+        bridge.close()
+        _dump_telemetry(args, tracer, telemetry)
     return 0

@@ -28,5 +28,7 @@ clocks (`parallel/tracker.py`) so each delta is applied exactly once.
 from kafka_ps_tpu.log.durable_fabric import DurableFabric
 from kafka_ps_tpu.log.log import CommitLog, LogConfig
 from kafka_ps_tpu.log.manager import LogManager
+from kafka_ps_tpu.log.tail import PartitionTailer, TopicTailer
 
-__all__ = ["CommitLog", "DurableFabric", "LogConfig", "LogManager"]
+__all__ = ["CommitLog", "DurableFabric", "LogConfig", "LogManager",
+           "PartitionTailer", "TopicTailer"]

@@ -240,6 +240,9 @@ class StreamingPSApp:
             self.server.task, registry,
             max_batch=scfg.max_batch,
             deadline_s=scfg.deadline_ms / 1000.0,
+            queue_limit=scfg.queue_limit,
+            shed_deadline_s=(scfg.shed_deadline_ms / 1000.0
+                             if scfg.shed_deadline_ms else None),
             tracer=self.tracer, telemetry=self.telemetry)
         return self.serving_engine
 

@@ -114,27 +114,37 @@ _TRACE_CTX = struct.Struct("<QQ")
 _PREDICT_HEADER = struct.Struct("<qdq")   # min_clock, max_age_s, n features
 # PREDICTION: status + (label, confidence, snapshot clock, snapshot time)
 _PREDICTION = struct.Struct("<Bqdqd")
-PREDICT_OK, PREDICT_STALE, PREDICT_FAILED = 0, 1, 2
+PREDICT_OK, PREDICT_STALE, PREDICT_FAILED, PREDICT_OVERLOADED = 0, 1, 2, 3
+# optional model-id trailer AFTER the feature row (multi-model serving,
+# docs/SERVING.md) — same append-and-length-check pattern as the codec
+# trailer, so frames from peers that never send it decode as model 0
+_MODEL_TRAILER = struct.Struct("<q")
 
 
 def encode_predict_request(x, min_clock: int | None = None,
-                           max_age_s: float | None = None) -> bytes:
+                           max_age_s: float | None = None,
+                           model_id: int = 0) -> bytes:
     import numpy as np
     row = np.asarray(x, dtype=np.float32).reshape(-1)
-    return _PREDICT_HEADER.pack(
+    return (_PREDICT_HEADER.pack(
         -1 if min_clock is None else int(min_clock),
         -1.0 if max_age_s is None else float(max_age_s),
         row.size) + row.tobytes()
+        + _MODEL_TRAILER.pack(int(model_id)))
 
 
 def decode_predict_request(payload: bytes):
-    """(features, min_clock | None, max_age_s | None)."""
+    """(features, min_clock | None, max_age_s | None, model_id)."""
     import numpy as np
     min_clock, max_age_s, n = _PREDICT_HEADER.unpack_from(payload, 0)
     row = np.frombuffer(payload, dtype=np.float32, count=n,
                         offset=_PREDICT_HEADER.size)
+    model_id = 0
+    tail = _PREDICT_HEADER.size + row.nbytes
+    if len(payload) >= tail + _MODEL_TRAILER.size:
+        (model_id,) = _MODEL_TRAILER.unpack_from(payload, tail)
     return (row, None if min_clock < 0 else min_clock,
-            None if max_age_s < 0 else max_age_s)
+            None if max_age_s < 0 else max_age_s, model_id)
 
 
 def encode_prediction(status: int, label: int = -1, confidence: float = 0.0,
@@ -418,6 +428,14 @@ class ServerBridge:
 
     def close(self) -> None:
         self._stop.set()
+        # shutdown BEFORE close: closing the fd does not wake a thread
+        # blocked in accept() — the in-flight syscall pins the kernel
+        # socket, leaving the port in LISTEN with no owner (a restart
+        # on the same port then fails EADDRINUSE until process exit)
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
@@ -621,9 +639,11 @@ class ServerBridge:
             self._send_raw(conn, T_PREDICTION, key,
                            encode_prediction(PREDICT_FAILED))
             return
-        from kafka_ps_tpu.serving.policy import ReadBound, StalenessError
+        from kafka_ps_tpu.serving.policy import (OverloadedError, ReadBound,
+                                                 StalenessError)
         try:
-            x, min_clock, max_age_s = decode_predict_request(payload)
+            x, min_clock, max_age_s, model_id = \
+                decode_predict_request(payload)
             bound = ReadBound(min_clock=min_clock, max_age_s=max_age_s)
         except Exception:  # noqa: BLE001 — malformed frame, not our crash
             self._send_raw(conn, T_PREDICTION, key,
@@ -631,7 +651,9 @@ class ServerBridge:
             return
 
         def reply(result, conn=conn, key=key):
-            if isinstance(result, StalenessError):
+            if isinstance(result, OverloadedError):
+                pl = encode_prediction(PREDICT_OVERLOADED)
+            elif isinstance(result, StalenessError):
                 pl = encode_prediction(PREDICT_STALE)
             elif isinstance(result, BaseException):
                 pl = encode_prediction(PREDICT_FAILED)
@@ -643,8 +665,15 @@ class ServerBridge:
             self._send_raw(conn, T_PREDICTION, key, pl)
 
         try:
-            engine.submit(x, bound, reply)
-        except RuntimeError:        # engine already closed (shutdown race)
+            engine.submit(x, bound, reply, model_id=model_id)
+        except OverloadedError:
+            # admission shed happens synchronously in submit — the fast
+            # rejection the bounded queue exists for: the reader thread
+            # answers immediately instead of parking work it cannot serve
+            self._send_raw(conn, T_PREDICTION, key,
+                           encode_prediction(PREDICT_OVERLOADED))
+        except (ValueError, RuntimeError):
+            # unknown model id, or engine already closed (shutdown race)
             self._send_raw(conn, T_PREDICTION, key,
                            encode_prediction(PREDICT_FAILED))
 
@@ -925,22 +954,84 @@ class PredictClient:
     PREDICT/PREDICTION (plus the server's PINGs, answered here to stay
     alive under heartbeat-timeout enforcement).  Synchronous: one
     outstanding request per client; run several clients for concurrency.
+
+    `reconnect=True` survives a dropped server connection the way the
+    split deployment's worker processes do (cli/socket_mode supervise):
+    on ConnectionError the client re-dials with exponential backoff up
+    to `reconnect_timeout` seconds and replays the in-flight request on
+    the fresh connection.  An OVERLOADED/STALE reply is a healthy
+    connection — those never trigger a re-dial.
     """
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self._sock = socket.create_connection((host, port), timeout=5.0)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.settimeout(timeout)
+    def __init__(self, host: str, port: int, timeout: float = 30.0, *,
+                 reconnect: bool = False, reconnect_timeout: float = 10.0,
+                 model_id: int = 0):
+        self._host, self._port = host, port
+        self._timeout = timeout
+        self._reconnect = reconnect
+        self._reconnect_timeout = reconnect_timeout
+        self._model_id = int(model_id)
         self._send_lock = OrderedLock("PredictClient.send")
         self._req = 0
+        self._closed = False
+        self.reconnects = 0          # successful re-dials (ops/test surface)
+        self._sock = self._dial()
+
+    def _dial(self) -> socket.socket:
+        sock = socket.create_connection((self._host, self._port),
+                                        timeout=5.0)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(self._timeout)
+        return sock
+
+    def _redial(self) -> None:
+        """Replace the dead socket, backing off exponentially (0.05 s
+        doubling to 1 s) until `reconnect_timeout` is spent."""
+        try:
+            force_close(self._sock)
+        except OSError:
+            pass
+        deadline = time.monotonic() + self._reconnect_timeout
+        backoff = 0.05
+        while not self._closed:
+            try:
+                self._sock = self._dial()
+                self.reconnects += 1
+                return
+            except OSError as err:
+                if time.monotonic() + backoff > deadline:
+                    raise ConnectionError(
+                        f"serving endpoint {self._host}:{self._port} did "
+                        f"not come back within {self._reconnect_timeout}s"
+                    ) from err
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 1.0)
+        raise ConnectionError("client closed during reconnect")
 
     def predict(self, x, min_clock: int | None = None,
-                max_age_s: float | None = None):
+                max_age_s: float | None = None,
+                model_id: int | None = None):
         """(label, confidence, vector_clock, wall_time) namedtuple;
-        raises serving.policy.StalenessError when the bound rejects."""
+        raises serving.policy.StalenessError when the bound rejects and
+        serving.policy.OverloadedError when the server shed the request
+        (admission queue full — back off and retry)."""
         self._req += 1
-        locked_send(self._sock, self._send_lock, T_PREDICT, self._req,
-                    encode_predict_request(x, min_clock, max_age_s))
+        payload = encode_predict_request(
+            x, min_clock, max_age_s,
+            self._model_id if model_id is None else model_id)
+        while True:
+            try:
+                locked_send(self._sock, self._send_lock, T_PREDICT,
+                            self._req, payload)
+                return self._await_reply(min_clock, max_age_s)
+            except (ConnectionError, OSError):
+                if not self._reconnect or self._closed:
+                    raise
+                # fresh socket, no stale frames: replaying the same
+                # request id is unambiguous (prediction is idempotent)
+                self._redial()
+
+    def _await_reply(self, min_clock, max_age_s):
         while True:
             frame = recv_frame(self._sock)
             if frame is None:
@@ -959,10 +1050,15 @@ class PredictClient:
                     f"server rejected the read bound (min_clock="
                     f"{min_clock}, max_age_s={max_age_s})",
                     min_clock=min_clock, max_age_s=max_age_s)
+            if status == PREDICT_OVERLOADED:
+                from kafka_ps_tpu.serving.policy import OverloadedError
+                raise OverloadedError(
+                    "server shed the request (admission queue full)")
             if status != PREDICT_OK:
                 raise RuntimeError("prediction failed on the server")
             from kafka_ps_tpu.serving.engine import Prediction
             return Prediction(label, conf, clock, wall)
 
     def close(self) -> None:
+        self._closed = True
         force_close(self._sock)

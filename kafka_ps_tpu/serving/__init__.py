@@ -17,18 +17,25 @@ so transport/client code can use them without pulling a backend;
 `engine` defers its jax imports to first prediction.
 """
 
-from kafka_ps_tpu.serving.policy import (EVENTUAL_READ, ReadBound,
-                                         StalenessError)
-from kafka_ps_tpu.serving.snapshot import Snapshot, SnapshotRegistry
+from kafka_ps_tpu.serving.policy import (EVENTUAL_READ, OverloadedError,
+                                         ReadBound, StalenessError)
+from kafka_ps_tpu.serving.snapshot import (FrontierCutPublisher,
+                                           MultiModelRegistry, Snapshot,
+                                           SnapshotRegistry)
 
-__all__ = ["EVENTUAL_READ", "ReadBound", "StalenessError", "Snapshot",
-           "SnapshotRegistry", "PredictionEngine", "Prediction"]
+__all__ = ["EVENTUAL_READ", "OverloadedError", "ReadBound",
+           "StalenessError", "Snapshot", "SnapshotRegistry",
+           "MultiModelRegistry", "FrontierCutPublisher",
+           "PredictionEngine", "Prediction", "ReplicaFollower"]
 
 
 def __getattr__(name):
-    # engine pulls in numpy/jax-adjacent machinery; load it only when a
-    # caller actually serves predictions
+    # engine/replica pull in numpy/jax-adjacent machinery; load them
+    # only when a caller actually serves predictions
     if name in ("PredictionEngine", "Prediction"):
         from kafka_ps_tpu.serving import engine
         return getattr(engine, name)
+    if name == "ReplicaFollower":
+        from kafka_ps_tpu.serving.replica import ReplicaFollower
+        return ReplicaFollower
     raise AttributeError(name)

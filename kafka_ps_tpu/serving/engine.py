@@ -5,11 +5,32 @@ Requests queue up; a single batcher thread coalesces them until either
 row arrived, then runs ONE jit'd forward pass over a padded fixed-shape
 batch. The amortization argument is identical to training-side gang
 dispatch (docs/GANG_DISPATCH.md): dispatch overhead is per-XLA-call, so
-k requests per call cost ~1/k of the per-request dispatch tax. The
-fixed (max_batch, F) shape means exactly one compile per model family.
+k requests per call cost ~1/k of the per-request dispatch tax.
 
-Each micro-batch resolves the snapshot registry ONCE — all rows in a
-batch are answered from the same (theta, clock) pair, and each row's
+Under load the engine protects itself instead of queueing to death
+(docs/SERVING.md, "Operating at load"):
+
+  * admission control — `queue_limit` bounds each tenant's outstanding
+    admitted requests; `submit` on a full queue raises a typed
+    `policy.OverloadedError` SYNCHRONOUSLY (the transport answers
+    OVERLOADED immediately; nothing is parked behind work that cannot
+    meet its deadline).  `shed_deadline_s` additionally sheds when the
+    predicted queueing delay (backlog / batch capacity x the EWMA batch
+    service time) exceeds the budget, even before the queue fills.
+  * adaptive micro-batch sizing — dispatch shapes are power-of-two
+    buckets of the live row count, capped at `max_batch`: light load
+    pays a small batch's compute, heavy load grows the batch toward the
+    cap instead of growing the dispatch count.  At most
+    log2(max_batch)+1 compiles per model family.
+
+Several model families serve from one engine: tenants register via
+`add_model(model_id, task, registry)`, requests carry a model id (wire
+trailer in runtime/net.py), and each tenant gets its own snapshot
+registry and its own admission budget — a hot tenant sheds without
+starving the others.
+
+Each per-tenant micro-batch resolves that tenant's registry ONCE — all
+its rows are answered from the same (theta, clock) pair, and each row's
 read bound is checked against that snapshot (the registry only ever
 serves its newest snapshot, so a bound the newest fails no snapshot
 passes; see serving/policy.py).
@@ -27,6 +48,7 @@ from typing import Callable, NamedTuple
 
 import numpy as np
 
+from kafka_ps_tpu.analysis.lockgraph import OrderedLock
 from kafka_ps_tpu.serving import policy
 from kafka_ps_tpu.serving.snapshot import SnapshotRegistry
 from kafka_ps_tpu.telemetry import NULL_TELEMETRY
@@ -45,21 +67,54 @@ class _Request(NamedTuple):
     bound: policy.ReadBound | None
     callback: Callable     # called with Prediction or an Exception
     t0: float              # monotonic enqueue time (latency accounting)
+    model_id: int          # tenant the request addresses
+
+
+class _Tenant:
+    """One served model family: its task, snapshot ring, compiled
+    forward, and admission-budget bookkeeping."""
+
+    __slots__ = ("model_id", "task", "registry", "predict", "depth",
+                 "last_traced_seq")
+
+    def __init__(self, model_id: int, task, registry: SnapshotRegistry):
+        self.model_id = model_id
+        self.task = task
+        self.registry = registry
+        self.predict = None        # jit'd forward, built on first dispatch
+        self.depth = 0             # admitted-but-unserved requests
+        # seq of the last snapshot whose delta.wire flow was closed here:
+        # the flow ends once, at the snapshot's FIRST serving read
+        self.last_traced_seq = -1
 
 
 _SENTINEL = object()
 
 
+def _bucket(n: int, cap: int) -> int:
+    """Smallest power of two >= n, capped — the adaptive dispatch shape."""
+    b = 1
+    while b < n and b < cap:
+        b <<= 1
+    return min(b, cap)
+
+
 class PredictionEngine:
-    """Deadline/size-capped micro-batcher over a SnapshotRegistry."""
+    """Deadline/size-capped micro-batcher over per-model snapshot rings
+    with bounded admission and explicit load shedding."""
 
     def __init__(self, task, registry: SnapshotRegistry, *,
                  max_batch: int = 16, deadline_s: float = 0.002,
+                 queue_limit: int = 0, shed_deadline_s: float | None = None,
+                 adaptive: bool = True,
                  tracer=None, telemetry=None, now=time.time):
-        self.task = task
-        self.registry = registry
         self.max_batch = max(1, int(max_batch))
         self.deadline_s = max(0.0, float(deadline_s))
+        # 0 = unbounded (the pre-admission-control behavior); > 0 bounds
+        # EACH tenant's outstanding admitted requests
+        self.queue_limit = max(0, int(queue_limit))
+        self.shed_deadline_s = shed_deadline_s
+        self.adaptive = adaptive
         self.tracer = tracer or NULL_TRACER
         self.telemetry = telemetry or NULL_TELEMETRY
         # pre-resolved metric children (null when telemetry is off):
@@ -68,40 +123,116 @@ class PredictionEngine:
         self._m_requests = self.telemetry.counter("serving_requests_total")
         self._m_rejections = self.telemetry.counter(
             "serving_rejections_total")
-        # seq of the last snapshot whose delta.wire flow was closed here:
-        # the flow ends once, at the snapshot's FIRST serving read
-        self._last_traced_seq = -1
+        self._m_queue_depth = self.telemetry.gauge("serving_queue_depth")
+        self._m_sheds = self.telemetry.counter("serving_shed_total")
+        self._m_batch_size = self.telemetry.histogram("serving_batch_size")
         self._now = now
         self._q: queue.SimpleQueue = queue.SimpleQueue()
+        # admission bookkeeping: depth counters must be exact (they gate
+        # sheds), so they move under one leaf lock, never nested
+        self._admission = OrderedLock("PredictionEngine.admission")
+        self._depth = 0            # total admitted-but-unserved requests
+        self._ewma_batch_s: float | None = None
+        self._tenants: dict[int, _Tenant] = {
+            0: _Tenant(0, task, registry)}
         self.latency = LatencyRecorder()
         # cumulative counters; status() exposes requests as a *_per_s key
         self.requests = 0
         self.batches = 0          # device dispatches (== jit calls)
         self.batched_rows = 0     # rows that made it into a dispatch
         self.rejections = 0       # staleness rejections
+        self.sheds = 0            # admission-control sheds (typed)
         self.errors = 0
-        self._predict = None      # jit'd forward, built on first dispatch
         self._closed = False
         self._thread = threading.Thread(
             target=self._loop, name="kps-serve-batch", daemon=True)
         self._thread.start()
 
+    # model-0 aliases — the single-tenant surface every existing caller
+    # (runtime/app.py, cli/, bench.py, tests) keeps using unchanged
+    @property
+    def task(self):
+        return self._tenants[0].task
+
+    @property
+    def registry(self) -> SnapshotRegistry:
+        return self._tenants[0].registry
+
+    # -- multi-model surface -------------------------------------------------
+    def add_model(self, model_id: int, task,
+                  registry: SnapshotRegistry | None = None,
+                  capacity: int = 8) -> SnapshotRegistry:
+        """Register another served model family.  Returns its registry
+        (created fresh when none is passed)."""
+        model_id = int(model_id)
+        with self._admission:
+            if model_id in self._tenants:
+                raise ValueError(f"model {model_id} already registered")
+            reg = registry if registry is not None \
+                else SnapshotRegistry(capacity=capacity)
+            self._tenants[model_id] = _Tenant(model_id, task, reg)
+            return reg
+
+    def model_ids(self) -> tuple[int, ...]:
+        return tuple(sorted(self._tenants))
+
+    def registry_for(self, model_id: int) -> SnapshotRegistry:
+        return self._tenants[model_id].registry
+
     # -- request entry points ----------------------------------------------
     def submit(self, x, bound: policy.ReadBound | None = None,
-               callback: Callable = lambda result: None) -> None:
+               callback: Callable = lambda result: None, *,
+               model_id: int = 0) -> None:
         """Async predict: callback fires on the batcher thread with a
         Prediction, or with the StalenessError/Exception that killed the
-        request. Never blocks the caller."""
+        request. Never blocks the caller; raises
+        policy.OverloadedError synchronously when admission control
+        sheds the request (reject fast — nothing is enqueued)."""
         if self._closed:
             raise RuntimeError("prediction engine is closed")
+        tenant = self._tenants.get(model_id)
+        if tenant is None:
+            raise ValueError(f"unknown model id {model_id}")
+        with self._admission:
+            if self.queue_limit and tenant.depth >= self.queue_limit:
+                self._shed(tenant, f"admission queue full "
+                                   f"({tenant.depth}/{self.queue_limit})")
+            if self.shed_deadline_s is not None \
+                    and self._ewma_batch_s is not None:
+                # predicted queueing delay: batches ahead of this row x
+                # the EWMA batch service time — when that already blows
+                # the deadline budget, queueing is a slower way to fail
+                predicted = ((self._depth // self.max_batch + 1)
+                             * self._ewma_batch_s)
+                if predicted > self.shed_deadline_s:
+                    self._shed(tenant,
+                               f"predicted queueing delay "
+                               f"{predicted * 1e3:.1f}ms > shed deadline "
+                               f"{self.shed_deadline_s * 1e3:.1f}ms")
+            tenant.depth += 1
+            self._depth += 1
+            if self.telemetry.enabled:
+                self._m_queue_depth.set(self._depth)
         # pscheck: disable=PS102 (client boundary: coerces caller-supplied x)
         row = np.asarray(x, dtype=np.float32).reshape(-1)
-        self._q.put(_Request(row, bound, callback, time.monotonic()))
+        self._q.put(_Request(row, bound, callback, time.monotonic(),
+                             model_id))
+
+    def _shed(self, tenant: _Tenant, why: str):
+        """Count + raise the typed rejection (admission lock held)."""
+        self.sheds += 1
+        self.tracer.count("serving.sheds")
+        if self.telemetry.enabled:
+            self._m_sheds.inc()
+        raise policy.OverloadedError(
+            f"request shed: {why}", queue_depth=tenant.depth,
+            queue_limit=self.queue_limit or None, model_id=tenant.model_id)
 
     def predict(self, x, bound: policy.ReadBound | None = None, *,
                 min_clock: int | None = None, max_age_s: float | None = None,
-                timeout: float = 30.0) -> Prediction:
-        """Sync predict; raises StalenessError if the bound rejects."""
+                model_id: int = 0, timeout: float = 30.0) -> Prediction:
+        """Sync predict; raises StalenessError if the bound rejects and
+        OverloadedError if admission control sheds."""
         if bound is None and (min_clock is not None or max_age_s is not None):
             bound = policy.ReadBound(min_clock=min_clock, max_age_s=max_age_s)
         done = threading.Event()
@@ -111,7 +242,7 @@ class PredictionEngine:
             box.append(result)
             done.set()
 
-        self.submit(x, bound, _cb)
+        self.submit(x, bound, _cb, model_id=model_id)
         if not done.wait(timeout):
             raise TimeoutError("prediction timed out")
         result = box[0]
@@ -146,17 +277,39 @@ class PredictionEngine:
 
     def _serve(self, batch: list[_Request]) -> None:
         self.requests += len(batch)
-        # one snapshot resolution per micro-batch: every row is answered
-        # from the same hot-swapped (theta, clock) pair
-        snap = self.registry.latest
-        now = self._now()
+        with self._admission:
+            for req in batch:
+                self._tenants[req.model_id].depth -= 1
+            self._depth -= len(batch)
+            if self.telemetry.enabled:
+                self._m_queue_depth.set(self._depth)
         if self.telemetry.enabled:
             self._m_requests.inc(len(batch))
-            if snap is not None:
-                # read-side staleness: how old the answering snapshot is
-                # at serve time (host floats; one sample per micro-batch)
-                self._m_snapshot_age.observe(
-                    max(0.0, (now - snap.wall_time) * 1e3))
+        # group by tenant, preserving arrival order within each group:
+        # one collected window serves every model family present in it
+        # (round-robin over model ids — no tenant waits an extra window)
+        groups: dict[int, list[_Request]] = {}
+        for req in batch:
+            groups.setdefault(req.model_id, []).append(req)
+        t_start = time.monotonic()
+        for model_id in sorted(groups):
+            self._serve_tenant(self._tenants[model_id], groups[model_id])
+        # EWMA of the window's service time feeds predictive shedding
+        dt = time.monotonic() - t_start
+        with self._admission:
+            self._ewma_batch_s = dt if self._ewma_batch_s is None \
+                else 0.2 * dt + 0.8 * self._ewma_batch_s
+
+    def _serve_tenant(self, tenant: _Tenant, batch: list[_Request]) -> None:
+        # one snapshot resolution per tenant micro-batch: every row is
+        # answered from the same hot-swapped (theta, clock) pair
+        snap = tenant.registry.latest
+        now = self._now()
+        if self.telemetry.enabled and snap is not None:
+            # read-side staleness: how old the answering snapshot is
+            # at serve time (host floats; one sample per micro-batch)
+            self._m_snapshot_age.observe(
+                max(0.0, (now - snap.wall_time) * 1e3))
         live: list[_Request] = []
         for req in batch:
             try:
@@ -172,7 +325,7 @@ class PredictionEngine:
         if not live:
             return
         try:
-            labels, confs = self._dispatch(snap, live)
+            labels, confs = self._dispatch(tenant, snap, live)
         except Exception as err:  # noqa: BLE001 — fail the rows, not the loop
             self.errors += 1
             for req in live:
@@ -181,23 +334,31 @@ class PredictionEngine:
         self.batches += 1
         self.batched_rows += len(live)
         self.tracer.count("serving.batch_dispatches")
+        if self.telemetry.enabled:
+            self._m_batch_size.observe(len(live))
         for i, req in enumerate(live):
             # pscheck: disable=PS102 (labels/confs are host arrays by here)
             self._finish(req, Prediction(int(labels[i]), float(confs[i]),
                                          snap.vector_clock, snap.wall_time))
 
-    def _dispatch(self, snap, live: list[_Request]):
-        fn = self._predict_fn()
-        xs = np.zeros((self.max_batch, self.task.cfg.num_features),
+    def _dispatch(self, tenant: _Tenant, snap, live: list[_Request]):
+        fn = self._predict_fn(tenant)
+        # adaptive shape: a power-of-two bucket of the live count means
+        # light load dispatches a small batch's compute while heavy load
+        # grows toward max_batch — batch size, not dispatch count,
+        # absorbs the offered rate (jit caches one program per bucket)
+        rows = _bucket(len(live), self.max_batch) if self.adaptive \
+            else self.max_batch
+        xs = np.zeros((rows, tenant.task.cfg.num_features),
                       dtype=np.float32)
         for i, req in enumerate(live):
             xs[i, :req.x.size] = req.x[:xs.shape[1]]
         with self.tracer.span("serving.predict", rows=len(live)):
-            if snap.trace is not None and snap.seq > self._last_traced_seq:
+            if snap.trace is not None and snap.seq > tenant.last_traced_seq:
                 # close the delta.wire flow on this snapshot's FIRST
                 # serving read: buffer -> solve -> wire -> apply ->
                 # publish -> here, one connected arrow chain in Perfetto
-                self._last_traced_seq = snap.seq
+                tenant.last_traced_seq = snap.seq
                 self.tracer.flow_end("delta.wire", snap.trace,
                                      clock=snap.vector_clock)
             labels, confs = fn(snap.theta, xs)
@@ -206,20 +367,42 @@ class PredictionEngine:
             confs = np.asarray(confs)  # pscheck: disable=PS102 (deliberate latency-sample sync)
         return labels, confs
 
-    def _predict_fn(self):
-        if self._predict is None:
+    def _predict_fn(self, tenant: _Tenant):
+        if tenant.predict is None:
             import jax
             import jax.numpy as jnp
 
-            task = self.task
+            task = tenant.task
 
             def _forward(theta, x):
                 lg = task.predict_logits(theta, x)
                 probs = jax.nn.softmax(lg, axis=-1)
                 return jnp.argmax(lg, axis=-1), jnp.max(probs, axis=-1)
 
-            self._predict = jax.jit(_forward)
-        return self._predict
+            tenant.predict = jax.jit(_forward)  # pscheck: disable=PS101 (built once, cached on the tenant)
+        return tenant.predict
+
+    def warmup(self, model_id: int = 0) -> int:
+        """Compile every adaptive bucket shape for a tenant against its
+        current snapshot (no-op when none is published).  Call before
+        measuring latency: a first-request XLA compile is orders of
+        magnitude over the deadline and would land in some poor
+        client's p99.  Returns the number of shapes compiled."""
+        tenant = self._tenants[model_id]
+        snap = tenant.registry.latest
+        if snap is None:
+            return 0
+        fn = self._predict_fn(tenant)
+        shapes = 0
+        b = 1 if self.adaptive else self.max_batch
+        while True:
+            xs = np.zeros((b, tenant.task.cfg.num_features), np.float32)
+            labels, _ = fn(snap.theta, xs)
+            np.asarray(labels)          # sync: compile finished
+            shapes += 1
+            if b >= self.max_batch:
+                return shapes
+            b <<= 1
 
     def _finish(self, req: _Request, result) -> None:
         self.latency.record(time.monotonic() - req.t0)
@@ -234,6 +417,7 @@ class PredictionEngine:
                      if self.batches else 0.0)
         out = {"requests": self.requests, "batches": self.batches,
                "occupancy": occupancy, "rejections": self.rejections,
+               "sheds": self.sheds, "queue_depth": self._depth,
                "errors": self.errors}
         out.update(self.latency.percentiles_ms(50, 99))
         return out

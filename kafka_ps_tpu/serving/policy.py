@@ -44,6 +44,25 @@ class StalenessError(RuntimeError):
         self.have_age_s = have_age_s
 
 
+class OverloadedError(RuntimeError):
+    """The engine shed this request at admission instead of queueing it
+    past any chance of meeting its deadline (docs/SERVING.md,
+    "Operating at load").
+
+    Typed — not a timeout, not a StalenessError — so transports map it
+    to an explicit OVERLOADED wire status and clients can distinguish
+    "back off and retry elsewhere" from a staleness rejection or a real
+    failure.  Carries the admission-queue state at shed time.
+    """
+
+    def __init__(self, message: str, *, queue_depth=None, queue_limit=None,
+                 model_id=None):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.queue_limit = queue_limit
+        self.model_id = model_id
+
+
 @dataclass(frozen=True)
 class ReadBound:
     """What a prediction request demands of the snapshot it reads.

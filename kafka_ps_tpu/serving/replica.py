@@ -1,0 +1,159 @@
+"""Log-following read replicas (docs/SERVING.md, "Operating at load").
+
+A replica is a serving process that never joins the training fabric: it
+tails the durable commit log's WEIGHTS partitions (log/tail.py — strict
+read-only, never truncating a live writer's torn tail) and republishes
+what it reads into a local `SnapshotRegistry`, which a stock
+`PredictionEngine` then serves from.  Read traffic scales by adding
+replica processes; the training deployment never sees a single extra
+syscall — the only coupling is the filesystem the log lives on.
+
+Two deployment shapes, auto-detected from the log directory layout:
+
+  * single server: `DIR/weights/<worker>/…` — every weights message
+    carries the full theta, so the replica publishes the newest message
+    by vector clock (the same rule as `DurableFabric.
+    latest_logged_weights`, incrementally).
+  * range-sharded (`--shards N`): `DIR/shard<i>of<N>/weights/…` — each
+    shard logs only its own key-range slice.  The replica keeps the
+    newest slice per shard and publishes through
+    `FrontierCutPublisher`, so a served snapshot is always a consistent
+    CUT stamped with the frontier clock (min per-shard clock), never a
+    torn mix of shard states.  This is exactly the assembled-theta
+    serving path that the live sharded runtime cannot offer
+    (socket_mode.run_server_shard rejects --serve); the replica closes
+    that gap.
+
+Snapshots published here enter the frontier-aware staleness policies of
+serving/policy.py unchanged: `min_clock` bounds below the frontier are
+satisfiable, `max_age_s` runs off the replica's publication time, and
+`at_clock` audit reads hit the replica's own retained ring.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+
+from kafka_ps_tpu.log.tail import TopicTailer
+from kafka_ps_tpu.runtime import serde
+from kafka_ps_tpu.serving.snapshot import (FrontierCutPublisher,
+                                           SnapshotRegistry)
+
+_SHARD_DIR = re.compile(r"^shard(\d+)of(\d+)$")
+
+
+def discover_shards(root: str) -> list[tuple[int, str]]:
+    """[(shard_id, shard_log_dir)…] for a SPLIT deployment's log root,
+    or [] when `root` is an unsharded (single-server) log."""
+    try:
+        names = os.listdir(root)
+    except FileNotFoundError:
+        return []
+    out = []
+    for name in names:
+        m = _SHARD_DIR.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, name)))
+    return sorted(out)
+
+
+class ReplicaFollower:
+    """Follow a durable log's weights partitions into a registry.
+
+    `catch_up()` is the synchronous unit of work (poll every tailer
+    once, publish whatever advanced) — tests and cold starts call it
+    directly; `start()` runs it on a background thread at
+    `poll_interval_s` until `stop()`.
+    """
+
+    def __init__(self, root: str, registry: SnapshotRegistry | None = None,
+                 *, poll_interval_s: float = 0.05, tracer=None):
+        self.root = root
+        self.registry = registry if registry is not None \
+            else SnapshotRegistry()
+        self.poll_interval_s = poll_interval_s
+        self.tracer = tracer
+        self.records_read = 0
+        self.publications = 0
+        shards = discover_shards(root)
+        self.num_shards = len(shards)
+        if shards:
+            self._tailers = {sid: TopicTailer(path) for sid, path in shards}
+            # newest (values, clock, range_start) seen per shard; a cut
+            # is publishable once every shard has reported at least once
+            self._newest: dict[int, tuple] = {}
+            self._cut = FrontierCutPublisher(self.registry)
+        else:
+            self._tailers = {0: TopicTailer(root)}
+            self._newest = {}
+            self._cut = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- synchronous follow ---------------------------------------------------
+
+    def catch_up(self) -> int:
+        """Poll every partition once; publish if the log advanced.
+        Returns the number of snapshots published."""
+        published = 0
+        advanced = False
+        for sid, tailer in self._tailers.items():
+            for _key, _offset, payload in tailer.poll():
+                self.records_read += 1
+                msg = serde.from_bytes(payload)
+                have = self._newest.get(sid)
+                if have is None or msg.vector_clock > have[1]:
+                    self._newest[sid] = (msg.values, msg.vector_clock,
+                                         msg.key_range.start)
+                    advanced = True
+        if not advanced:
+            return 0
+        if self._cut is not None:
+            if len(self._newest) == self.num_shards:
+                # shard-id order == key_range.start order for range
+                # sharding, but sort by range start explicitly — the
+                # concatenation must tile the key space in order
+                cut = [(values, clock) for values, clock, _start
+                       in sorted(self._newest.values(),
+                                 key=lambda t: t[2])]
+                if self._cut.maybe_publish(cut) is not None:
+                    published = 1
+        else:
+            values, clock, _start = self._newest[0]
+            latest = self.registry.latest
+            if latest is None or clock > latest.vector_clock:
+                self.registry.publish(values, clock)
+                published = 1
+        if published:
+            self.publications += 1
+            if self.tracer is not None:
+                self.tracer.count("replica.publications")
+        return published
+
+    @property
+    def clock(self) -> int | None:
+        latest = self.registry.latest
+        return None if latest is None else latest.vector_clock
+
+    # -- background follow ----------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("replica follower already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._follow, daemon=True,
+                                        name="kps-replica-tail")
+        self._thread.start()
+
+    def _follow(self) -> None:
+        while not self._stop.is_set():
+            self.catch_up()
+            self._stop.wait(self.poll_interval_s)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=timeout)

@@ -111,6 +111,49 @@ class SnapshotRegistry:
             else self._latest.vector_clock)
 
 
+class MultiModelRegistry:
+    """SnapshotRegistry per model id — several model families serving
+    from one process (multi-tenant serving, docs/SERVING.md).
+
+    Pure routing: each tenant keeps its own independent snapshot ring
+    (its own publisher, its own staleness story); this class only maps
+    the wire-level model id to the right ring.  The engine layers
+    per-tenant admission budgets on top (serving/engine.py), so one hot
+    model family sheds without starving the others.
+    """
+
+    def __init__(self):
+        self._registries: dict[int, SnapshotRegistry] = {}
+        self._lock = OrderedLock("MultiModelRegistry.register")
+
+    def register(self, model_id: int,
+                 registry: SnapshotRegistry | None = None,
+                 capacity: int = 8) -> SnapshotRegistry:
+        """Idempotent: returns the existing ring when `model_id` is
+        already registered (and rejects replacing it with a different
+        one — a tenant's ring is its serving history)."""
+        with self._lock:
+            have = self._registries.get(model_id)
+            if have is not None:
+                if registry is not None and registry is not have:
+                    raise ValueError(
+                        f"model {model_id} already registered")
+                return have
+            reg = registry if registry is not None \
+                else SnapshotRegistry(capacity=capacity)
+            self._registries[int(model_id)] = reg
+            return reg
+
+    def get(self, model_id: int) -> SnapshotRegistry | None:
+        return self._registries.get(model_id)
+
+    def model_ids(self) -> tuple[int, ...]:
+        return tuple(sorted(self._registries))
+
+    def __len__(self) -> int:
+        return len(self._registries)
+
+
 class FrontierCutPublisher:
     """Cross-shard consistent snapshots (range sharding, docs/SHARDING.md).
 

@@ -74,6 +74,8 @@ class ServingConfig:
     max_batch: int = 16           # micro-batch size cap (one jit shape)
     deadline_ms: float = 2.0      # max wait to fill a micro-batch
     ring_capacity: int = 8        # retained snapshots (at_clock reads)
+    queue_limit: int = 0          # per-tenant admission budget; 0 = none
+    shed_deadline_ms: float = 0.0  # predictive shed threshold; 0 = off
 
 
 @dataclasses.dataclass(frozen=True)

@@ -33,6 +33,13 @@
 # gradients partition through a fresh ServerNode and comparing against
 # the shard's final checkpoint theta bytes (docs/SHARDING.md).
 #
+# `scripts/tier1.sh --load` runs the serving-load smoke leg: a child
+# training process serving over a socket (--serve --serve_port
+# --serve-queue) driven by THIS process's load generator — zero
+# deadline violations at low rate, >=1 explicit typed shed under a
+# flash crowd, and the trained theta bitwise-identical to a no-load
+# run (docs/SERVING.md, "Operating at load").
+#
 # `scripts/tier1.sh --analyze` runs the static-analysis leg: pscheck
 # (docs/ANALYSIS.md) over the package — fails on ANY unsuppressed
 # finding — plus ruff (pyproject.toml, rule sets E/F/B/PLE) when the
@@ -55,6 +62,117 @@ if [[ "${1:-}" == "--analyze" ]]; then
     fi
     echo ANALYZE_OK
     exit 0
+fi
+
+if [[ "${1:-}" == "--load" ]]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+# two processes: a child training run serving over a socket, and THIS
+# process driving it with the load generator.  The quiet arm repeats
+# the identical (serial, deterministic) training run with serving off:
+# read load must never perturb training — theta bitwise-identical.
+root = tempfile.mkdtemp(prefix="kps-load-")
+repo = os.getcwd()
+rng = np.random.default_rng(0)
+x = rng.normal(size=(256, 8)).astype(np.float32)
+y = (x[:, 0] > 0).astype(np.int32) + 1
+train, test = os.path.join(root, "train.csv"), os.path.join(root, "test.csv")
+for path, (xx, yy) in ((train, (x[:200], y[:200])),
+                       (test, (x[200:], y[200:]))):
+    with open(path, "w") as fh:
+        fh.write(",".join(f"f{i}" for i in range(8)) + ",Score\n")
+        for r, lab in zip(xx, yy):
+            fh.write(",".join(f"{v:.6f}" for v in r) + f",{lab}\n")
+
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+# sized so training ALWAYS outlasts the ~9 s load window: ~450
+# unloaded iters/s on the reference 1-core box -> ~16 s floor, and the
+# load itself only slows the trainer down; liveness asserts below turn
+# a too-fast trainer into a clear failure instead of an error storm
+MAX_IT = 7200
+common = ["-training", train, "-test", test, "--num_workers", "2",
+          "--num_features", "8", "--num_classes", "2", "-min", "8",
+          "-max", "32", "-p", "2", "-c", "0", "--mode", "serial",
+          "--eval_every", "1000000", "--max_iterations", str(MAX_IT),
+          "--checkpoint_every", "50"]
+
+def arm(serve):
+    ckpt = os.path.join(root, ("serve" if serve else "quiet") + ".npz")
+    cmd = [sys.executable, "-m", "kafka_ps_tpu.cli.run", *common,
+           "--checkpoint", ckpt]
+    if serve:
+        cmd += ["--serve", "--serve_port", "0", "--serve-queue", "4"]
+    proc = subprocess.Popen(cmd, env=env, cwd=root, text=True,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE)
+    port = None
+    if serve:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stderr.readline()
+            if not line:
+                break
+            m = re.search(r"serving on port (\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        if not port:
+            proc.kill()
+            raise SystemExit("child never announced its serving port")
+    return proc, port, ckpt
+
+from kafka_ps_tpu.serving import loadgen
+
+proc, port, serve_ckpt = arm(serve=True)
+target = loadgen.SocketTarget("127.0.0.1", port)
+try:
+    # one connection to pay the jit warmup before anything is measured
+    loadgen.run_closed_loop(target, 8, concurrency=1, duration_s=1.0)
+    # low rate: every request answered within the smoke SLO (500 ms is
+    # generous on purpose — one core shared with training; observed
+    # p99 is 30-100 ms), nothing shed, nothing errored
+    low = loadgen.run_closed_loop(target, 8, concurrency=2,
+                                  duration_s=3.0)
+    # flash crowd: 32 in-flight against a 4-deep admission queue must
+    # shed EXPLICITLY (typed PREDICT_OVERLOADED), never time out
+    over = loadgen.run_closed_loop(target, 8, concurrency=32,
+                                   duration_s=3.0)
+    # the whole point is load DURING training: if the trainer already
+    # exited, the run above measured a dead socket, not admission
+    assert proc.poll() is None, \
+        "trainer finished before the load window (raise MAX_IT)"
+finally:
+    target.close()
+rc = proc.wait(timeout=240)
+err = proc.stderr.read()
+assert rc == 0, f"serving arm rc={rc}\n{err[-4000:]}"
+assert low.meets(500.0), f"low-rate SLO violated: {low.as_dict()}"
+assert over.shed >= 1, f"flash crowd never shed: {over.as_dict()}"
+assert over.errors == 0, f"sheds must be typed: {over.as_dict()}"
+
+quiet, _, quiet_ckpt = arm(serve=False)
+rc = quiet.wait(timeout=240)
+assert rc == 0, f"quiet arm rc={rc}\n{quiet.stderr.read()[-4000:]}"
+zs, zq = np.load(serve_ckpt), np.load(quiet_ckpt)
+assert int(zs["iterations"]) >= MAX_IT <= int(zq["iterations"])
+ts = np.asarray(zs["theta"], np.float32)
+tq = np.asarray(zq["theta"], np.float32)
+assert ts.tobytes() == tq.tobytes(), \
+    "read load perturbed training theta"
+print(f"LOAD_SMOKE_OK low_p99_ms={low.p99_ms} low_ok={low.ok} "
+      f"sheds={over.shed} shed_rate={over.shed_rate:.3f} "
+      f"theta=bitwise-identical iters={MAX_IT}")
+EOF
+    exit $?
 fi
 
 if [[ "${1:-}" == "--shard" ]]; then

@@ -1,0 +1,9 @@
+"""PS102 positive fixture (scoped: lives under a serving/ path): a
+host sync on the load generator's per-request driver path — it is
+charged to every request the generator issues, skewing the very
+latency the harness measures."""
+
+
+class Driver:
+    def _drive(self, sched, i):
+        return float(sched[i])

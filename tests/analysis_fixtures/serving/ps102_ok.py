@@ -1,0 +1,13 @@
+"""PS102 negative fixture: the schedule is materialized to host floats
+ONCE, outside the driver; the per-request path stays sync-free."""
+import numpy as np
+
+
+def build_schedule(rate_qps, duration_s):
+    # not a per-request handler — host materialization is expected here
+    return [float(t) for t in np.arange(0.0, duration_s, 1.0 / rate_qps)]
+
+
+class Driver:
+    def _drive(self, sched, i):
+        return sched[i]

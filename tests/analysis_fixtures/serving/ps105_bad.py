@@ -1,0 +1,11 @@
+"""PS105 positive fixture: the load generator's issue path writes to
+the socket while still holding the round-robin pick lock — every
+other issuing thread stalls behind one peer's TCP backpressure."""
+import threading
+
+_lock = threading.Lock()
+
+
+def make_issue(sock, payload):
+    with _lock:
+        sock.sendall(payload)

@@ -1,0 +1,14 @@
+"""PS105 negative fixture: the lock covers only the round-robin pick;
+the blocking socket write happens outside the critical section."""
+import threading
+
+_lock = threading.Lock()
+_next = [0]
+
+
+def make_issue(sock, payload, targets):
+    with _lock:
+        pick = targets[_next[0] % len(targets)]
+        _next[0] += 1
+    sock.sendall(payload)
+    return pick

@@ -32,6 +32,8 @@ def _findings(relpath: str):
     ("ps104_sharding_bad/runtime/sharding.py", "PS104"),
     ("ps104_sharding_bad/parallel/range_sharded.py", "PS104"),
     ("ps105_bad.py", "PS105"),
+    ("serving/ps102_bad.py", "PS102"),
+    ("serving/ps105_bad.py", "PS105"),
     ("runtime/ps106_bad.py", "PS106"),
 ])
 def test_positive_fixture_triggers_exactly_once(relpath, rule):
@@ -48,6 +50,8 @@ def test_positive_fixture_triggers_exactly_once(relpath, rule):
     "ps104_sharding_ok/runtime/sharding.py",
     "ps104_sharding_ok/parallel/range_sharded.py",
     "ps105_ok.py",
+    "serving/ps102_ok.py",
+    "serving/ps105_ok.py",
     "runtime/ps106_ok.py",
 ])
 def test_negative_fixture_stays_clean(relpath):

@@ -1,0 +1,84 @@
+"""bench_out.json schema contract.
+
+The committed detail document and bench.py's KNOWN_BLOCKS list must
+agree: a refactor that renames or drops a block fails HERE against the
+file on disk, not in whoever consumes bench_out.json next (the observed
+drift: blocks silently vanishing from the committed document while the
+summary line kept reporting them).
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def bench_module():
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+        return bench
+    finally:
+        sys.path.remove(str(REPO))
+
+
+@pytest.fixture(scope="module")
+def committed_doc():
+    path = REPO / "bench_out.json"
+    if not path.exists():
+        pytest.skip("bench_out.json not generated in this checkout")
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def test_known_blocks_is_the_schema(bench_module):
+    # every block name the bench can emit, exactly once, sorted check
+    # left to humans — but no duplicates and nothing empty
+    blocks = bench_module.KNOWN_BLOCKS
+    assert len(blocks) == len(set(blocks))
+    assert all(isinstance(b, str) and b for b in blocks)
+    assert "serving_load" in blocks            # this PR's block
+
+
+def test_committed_doc_has_every_known_block(bench_module, committed_doc):
+    paths = committed_doc["detail"]["paths"]
+    missing = [b for b in bench_module.KNOWN_BLOCKS if b not in paths]
+    assert not missing, f"bench_out.json missing blocks: {missing}"
+    # and the reverse: a block on disk that KNOWN_BLOCKS forgot is the
+    # same schema drift from the other side
+    unknown = [b for b in paths if b not in bench_module.KNOWN_BLOCKS]
+    assert not unknown, f"KNOWN_BLOCKS missing entries: {unknown}"
+
+
+def test_serving_load_block_shape(committed_doc):
+    load = committed_doc["detail"]["paths"].get("serving_load")
+    if load is None:
+        pytest.skip("committed doc predates serving_load")
+    for key in ("deadline_ms", "single", "two_replicas", "replica_scaling",
+                "flash_crowd_knee", "overload_2x", "overload_bursty",
+                "socket_closed_loop"):
+        assert key in load, key
+    assert load["single"]["knee_qps"] > 0
+    assert load["two_replicas"]["knee_qps"] > 0
+    # the typed-shed contract: under 2x overload some requests are shed
+    # and the ACCEPTED ones still meet the deadline
+    over = load["overload_2x"]
+    assert over["shed"] > 0 and over["errors"] == 0
+    assert over["p99_ms"] is not None
+    assert over["p99_ms"] <= load["deadline_ms"]
+
+
+def test_summary_line_stays_one_short_line(committed_doc):
+    # mirror of the bench's own self-check, against the committed doc:
+    # the summary recomputed from detail must stay under the tail-
+    # truncation budget (the compact stdout line is < 1900 chars)
+    line = json.dumps({"metric": committed_doc["metric"],
+                       "value": committed_doc["value"],
+                       "summary": committed_doc.get("summary", {})},
+                      separators=(",", ":"))
+    assert "\n" not in line
+    assert len(line) < 1900

@@ -28,7 +28,7 @@ import yaml
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEPLOY = os.path.join(REPO, "deploy")
-K8S_MANIFESTS = ("k8s/job.yaml", "k8s/split.yaml")
+K8S_MANIFESTS = ("k8s/job.yaml", "k8s/split.yaml", "k8s/replica.yaml")
 
 
 def _load(relpath: str) -> list[dict]:
@@ -116,6 +116,42 @@ def test_split_manifest_args_parse_against_the_real_cli_surfaces():
     wargs = _parse_with(worker_runner.build_parser(), wc["args"])
     assert wargs.connect == "kps-server:8477"
     assert wargs.worker_ids == "0,1,2,3"
+
+
+def test_replica_manifest_is_a_read_only_autoscaled_serving_tier():
+    from kafka_ps_tpu.cli import server_runner
+
+    docs = {d["kind"]: d for d in _load("k8s/replica.yaml")}
+    service, dep = docs["Service"], docs["Deployment"]
+    hpa = docs["HorizontalPodAutoscaler"]
+    (c,) = _containers(dep)
+
+    # the args drive the real CLI surface in replica mode: log-follow
+    # serving, never the training fabric (no --listen)
+    assert c["command"][-1] == "kafka_ps_tpu.cli.server_runner"
+    args = _parse_with(server_runner.build_parser(), c["args"])
+    assert args.serve_replica and args.listen is None
+    assert args.durable_log == "/log"
+    assert args.serve_queue > 0          # admission control is on
+
+    # service routes to the pods on the port --serve_port binds
+    port = service["spec"]["ports"][0]["port"]
+    assert args.serve_port == port
+    assert c["ports"][0]["containerPort"] == port
+    assert service["spec"]["selector"] == \
+        dep["spec"]["selector"]["matchLabels"]
+
+    # the log volume is mounted read-only: the tailer never truncates
+    # a live writer's torn tail (log/tail.py), and the mount enforces it
+    (mount,) = c["volumeMounts"]
+    assert mount["mountPath"] == args.durable_log
+    assert mount["readOnly"] is True
+
+    # the HPA owns the replica count of THIS deployment
+    assert hpa["spec"]["scaleTargetRef"]["name"] == \
+        dep["metadata"]["name"]
+    assert hpa["spec"]["minReplicas"] >= 1
+    assert hpa["spec"]["maxReplicas"] > hpa["spec"]["minReplicas"]
 
 
 def test_job_manifest_args_parse_and_encode_the_kps_contract():

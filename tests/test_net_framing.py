@@ -397,15 +397,29 @@ def test_send_data_batch_to_unknown_worker_returns_false():
 
 def test_predict_request_codec_roundtrip():
     x = np.arange(6, dtype=np.float32)
-    row, min_clock, max_age = net.decode_predict_request(
+    row, min_clock, max_age, model = net.decode_predict_request(
         net.encode_predict_request(x, min_clock=7, max_age_s=1.5))
     np.testing.assert_array_equal(row, x)
-    assert (min_clock, max_age) == (7, 1.5)
+    assert (min_clock, max_age, model) == (7, 1.5, 0)
     # unbounded request: both sentinels decode back to None
-    row, min_clock, max_age = net.decode_predict_request(
+    row, min_clock, max_age, model = net.decode_predict_request(
         net.encode_predict_request(x))
     np.testing.assert_array_equal(row, x)
-    assert (min_clock, max_age) == (None, None)
+    assert (min_clock, max_age, model) == (None, None, 0)
+
+
+def test_predict_request_model_trailer():
+    x = np.arange(4, dtype=np.float32)
+    row, _, _, model = net.decode_predict_request(
+        net.encode_predict_request(x, model_id=3))
+    np.testing.assert_array_equal(row, x)
+    assert model == 3
+    # a frame from a peer that predates the trailer (header + row only)
+    # decodes as the default tenant — the trailer-negotiation contract
+    legacy = net._PREDICT_HEADER.pack(-1, -1.0, x.size) + x.tobytes()
+    row, min_clock, max_age, model = net.decode_predict_request(legacy)
+    np.testing.assert_array_equal(row, x)
+    assert (min_clock, max_age, model) == (None, None, 0)
 
 
 def test_prediction_codec_roundtrip():
@@ -416,6 +430,9 @@ def test_prediction_codec_roundtrip():
     status, *_ = net.decode_prediction(
         net.encode_prediction(net.PREDICT_STALE))
     assert status == net.PREDICT_STALE
+    status, *_ = net.decode_prediction(
+        net.encode_prediction(net.PREDICT_OVERLOADED))
+    assert status == net.PREDICT_OVERLOADED
 
 
 def _serving_engine():
@@ -460,6 +477,75 @@ def test_predict_client_end_to_end():
         bridge.close()
         engine.close()
     assert bridge.dropped_sends == 0
+
+
+def test_predict_client_reconnects_after_server_restart():
+    """Kill the serving socket mid-load and restart it on the same
+    port: a reconnect-enabled client re-dials with backoff and replays
+    the in-flight request; without reconnect the drop is an error."""
+    engine, cfg = _serving_engine()
+    bridge = net.ServerBridge()
+    bridge.attach_serving(engine)
+    port = bridge.port
+    client = net.PredictClient("127.0.0.1", port, reconnect=True,
+                               reconnect_timeout=15.0)
+    plain = net.PredictClient("127.0.0.1", port)
+    x = np.ones(cfg.num_features, np.float32)
+    bridge2 = None
+    try:
+        assert client.predict(x).vector_clock == 9
+        assert plain.predict(x).vector_clock == 9
+
+        bridge.close()                  # the mid-load kill
+        # restart serving on the SAME port (retry through TIME_WAIT)
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                bridge2 = net.ServerBridge(port=port)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+        bridge2.attach_serving(engine)
+
+        # reconnecting client recovers transparently and counts it
+        assert client.predict(x).vector_clock == 9
+        assert client.reconnects >= 1
+        # a healthy reply is not a reconnect trigger
+        before = client.reconnects
+        assert client.predict(x).vector_clock == 9
+        assert client.reconnects == before
+        # the plain client surfaces the drop instead of retrying
+        with pytest.raises((ConnectionError, OSError, RuntimeError)):
+            plain.predict(x)
+    finally:
+        client.close()
+        plain.close()
+        if bridge2 is not None:
+            bridge2.close()
+        engine.close()
+
+
+def test_predict_client_reconnect_budget_exhausts():
+    """No listener ever comes back: the re-dial loop must give up
+    within its budget with ConnectionError, not spin forever."""
+    engine, cfg = _serving_engine()
+    bridge = net.ServerBridge()
+    bridge.attach_serving(engine)
+    client = net.PredictClient("127.0.0.1", bridge.port, reconnect=True,
+                               reconnect_timeout=0.5)
+    x = np.ones(cfg.num_features, np.float32)
+    try:
+        assert client.predict(x).vector_clock == 9
+        bridge.close()
+        t0 = time.monotonic()
+        with pytest.raises((ConnectionError, OSError)):
+            client.predict(x)
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        client.close()
+        engine.close()
 
 
 def test_predict_without_engine_fails_cleanly():
