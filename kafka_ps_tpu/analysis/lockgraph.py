@@ -105,6 +105,16 @@ class LockGraph:
             out.append([edges[(a, b)] for a, b in zip(cyc, cyc[1:] + cyc[:1])])
         return out
 
+    def export_edges(self) -> list[dict]:
+        """Every observed ordering edge as plain JSON-safe dicts — the
+        public read surface the flight recorder's dump uses
+        (telemetry/flight.py), so postmortem tooling sees the lock
+        order a dead process had actually exercised."""
+        with self._mu:
+            edges = list(self.edges.values())
+        return [{"src": e.src, "dst": e.dst, "site": e.site,
+                 "thread": e.thread} for e in edges]
+
     def summary(self) -> str:
         with self._mu:
             return (f"{len(self.names)} locks, {len(self.edges)} ordered "

@@ -28,7 +28,8 @@ time; these rules catch the regressions at commit time instead:
   PS106  host-sync calls (``.item()``, ``float()``, ``np.asarray``,
          ``np.array``, ``.block_until_ready()``) inside the ARGUMENTS
          of a telemetry/trace call (``span``, ``count``, ``observe``,
-         ``inc``, ``flow_*``) in ``runtime/``, ``ops/`` or
+         ``inc``, ``flow_*``) or a flight-recorder call (``record``,
+         telemetry/flight.py) in ``runtime/``, ``ops/`` or
          ``serving/`` — instrumentation must observe host scalars
          only; a metric that syncs the device perturbs the very
          latency it measures and breaks the telemetry-off/on bitwise
@@ -69,7 +70,7 @@ RULES: dict[str, str] = {
              "(log/, compress/, runtime/serde.py)",
     "PS105": "blocking I/O while holding a lock",
     "PS106": "host-sync call inside the arguments of a telemetry/trace "
-             "call in runtime/, ops/ or serving/",
+             "or flight-recorder call in runtime/, ops/ or serving/",
 }
 
 # -- rule scoping ----------------------------------------------------------
@@ -96,12 +97,15 @@ _SYNC_ATTRS = frozenset({"item", "block_until_ready"})
 _NP_SYNC_ATTRS = frozenset({"asarray", "array"})
 
 # PS106: attribute-call names that record telemetry (utils/trace.Tracer
-# + telemetry/registry metric children).  `.set` is deliberately absent
+# + telemetry/registry metric children + the flight recorder's
+# FLIGHT.record, telemetry/flight.py — its event fields must be host
+# ints that the hot path already owns).  `.set` is deliberately absent
 # — it collides with jax's `.at[...].set(...)`; gauge .set sites are
 # covered by the generic PS102 handler scoping instead.
 _TELEMETRY_ATTRS = frozenset({
     "span", "count", "observe", "inc",
     "flow", "flow_start", "flow_step", "flow_end",
+    "record",
 })
 
 # PS104 banned call roots

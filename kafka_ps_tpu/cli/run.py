@@ -107,6 +107,26 @@ def build_parser(include_server_flags: bool = True,
                    default=0.0, metavar="SECONDS",
                    help="with --metrics-file: rewrite the dump every N "
                         "seconds (atomic replace; 0 = only at exit)")
+    p.add_argument("--flight-dir", dest="flight_dir", default=None,
+                   metavar="DIR",
+                   help="enable the always-on flight recorder "
+                        "(telemetry/flight.py, docs/OBSERVABILITY.md): "
+                        "per-thread rings of structured events (gate "
+                        "decisions, queue depths, frame sends, fsyncs, "
+                        "snapshot publishes) dumped atomically to "
+                        "DIR/flightdump-<pid>.json on SIGTERM/SIGABRT/"
+                        "fatal signals, on watchdog trips, and at clean "
+                        "exit; `python -m kafka_ps_tpu.telemetry "
+                        "postmortem DIR` merges dumps across processes "
+                        "and names the culprit")
+    p.add_argument("--health-port", dest="health_port", type=int,
+                   default=None, metavar="PORT",
+                   help="serve the health/introspection plane on this "
+                        "port (0 = ephemeral, printed to stderr): "
+                        "/healthz watchdog-derived liveness/readiness "
+                        "(the k8s probe target, deploy/k8s/*.yaml), "
+                        "/varz Prometheus metrics snapshot, /flightz "
+                        "recent flight-ring tail")
     p.add_argument("--device_trace", default=None, metavar="LOGDIR",
                    help="capture a jax.profiler device trace (TensorBoard "
                         "logdir) for the whole run")
@@ -282,8 +302,12 @@ def make_app_from_args(args, resuming: bool = False,
         from kafka_ps_tpu.utils.trace import Tracer
         tracer = Tracer()
     from kafka_ps_tpu.telemetry import maybe_telemetry
+    # /varz serves this same registry, so a requested health plane
+    # arms metrics even without a --metrics-file dump target
     telemetry = maybe_telemetry(
-        tracer, want_metrics=bool(getattr(args, "metrics_file", None)))
+        tracer,
+        want_metrics=bool(getattr(args, "metrics_file", None))
+        or getattr(args, "health_port", None) is not None)
     fabric = None
     if getattr(args, "durable_log", None):
         from kafka_ps_tpu.log import DurableFabric, LogConfig
@@ -434,13 +458,14 @@ def run_with_args(args) -> int:
             print(f"    durable-log replay: {counts}")
 
     serve_bridge = None
+    serve_engine = None
     if getattr(args, "serve", False):
         if distributed:
             raise SystemExit(
                 "--serve is single-process: the serving plane reads the "
                 "server's snapshot registry in-process (run a dedicated "
                 "serving host against the checkpoint instead)")
-        engine = app.enable_serving()
+        engine = serve_engine = app.enable_serving()
         # cold start (docs/SERVING.md): the restored (or fresh) theta is
         # servable before the first gate release...
         app.server.publish_snapshot()
@@ -502,6 +527,19 @@ def run_with_args(args) -> int:
             local_pos = multihost.local_worker_ids(len(active), mesh)
             app.local_workers = {active[i] for i in local_pos}
 
+    # flight recorder + watchdogs + health plane (docs/OBSERVABILITY.md)
+    # — wired unconditionally; inert unless --flight-dir/--health-port
+    from kafka_ps_tpu.telemetry.health import OpsPlane
+    ops = OpsPlane(flight_dir=getattr(args, "flight_dir", None),
+                   health_port=getattr(args, "health_port", None),
+                   telemetry=app.telemetry, role="run")
+    ops.add_gate_watchdog(app.server)
+    if getattr(args, "durable_log", None):
+        ops.add_fsync_watchdog()
+    if serve_engine is not None:
+        ops.add_serving_watchdog(serve_engine)
+    ops.start()
+
     metrics_file = getattr(args, "metrics_file", None)
     if metrics_file and getattr(args, "metrics_every", 0.0) > 0:
         # periodic Prometheus-style dump (atomic replace) so an external
@@ -546,6 +584,9 @@ def run_with_args(args) -> int:
         if serve_bridge is not None:
             serve_bridge.close()
         app.close_serving()
+        # ops plane after serving, before the logs: the final flight
+        # dump still sees live telemetry and a coherent ring
+        ops.close()
         if args.checkpoint and process_index == 0:
             # routed through the server so a durable fabric commits the
             # offsets this final snapshot covers (a commit point)

@@ -82,12 +82,30 @@ def _make_telemetry(args):
     if getattr(args, "trace", None):
         from kafka_ps_tpu.utils.trace import Tracer
         tracer = Tracer()
+    # /varz serves this same registry, so a requested health plane
+    # arms metrics even without a --metrics-file dump target
     telemetry = maybe_telemetry(
-        tracer, want_metrics=bool(getattr(args, "metrics_file", None)))
+        tracer,
+        want_metrics=bool(getattr(args, "metrics_file", None))
+        or getattr(args, "health_port", None) is not None)
     if getattr(args, "metrics_file", None) \
             and getattr(args, "metrics_every", 0.0) > 0:
         telemetry.start_dumper(args.metrics_file, args.metrics_every)
     return tracer, telemetry
+
+
+def _make_ops(args, telemetry, *, role, shard=None, meta=None):
+    """Flight recorder + watchdogs + health plane for one split-mode
+    process (telemetry/health.py, docs/OBSERVABILITY.md).  Inert unless
+    --flight-dir/--health-port, so every role wires it unconditionally;
+    with --flight-dir the process also dumps its rings on SIGTERM/
+    SIGABRT/fatal signals — the raw material of `python -m
+    kafka_ps_tpu.telemetry postmortem`."""
+    from kafka_ps_tpu.telemetry.health import OpsPlane
+    return OpsPlane(flight_dir=getattr(args, "flight_dir", None),
+                    health_port=getattr(args, "health_port", None),
+                    telemetry=telemetry, role=role, shard=shard,
+                    meta=meta)
 
 
 def _dump_telemetry(args, tracer, telemetry) -> None:
@@ -269,6 +287,12 @@ def run_server(args) -> int:
         print(f"serving predictions on port {bridge.port}",
               file=sys.stderr, flush=True)
 
+    ops = _make_ops(args, telemetry, role="server")
+    ops.add_gate_watchdog(server)
+    if engine is not None:
+        ops.add_serving_watchdog(engine)
+    ops.start()
+
     # membership events cross threads (bridge readers -> main loop):
     # ServerNode is single-threaded by design, so evictions/readmissions
     # are applied only between gradient polls
@@ -407,6 +431,7 @@ def run_server(args) -> int:
                   f"{bridge.dropped_sends}", file=sys.stderr, flush=True)
         server.log.close()           # joins drain thread + closes sink
         events_log.close()
+        ops.close()                  # final flight dump + health down
         _dump_telemetry(args, tracer, telemetry)
     return 0
 
@@ -444,6 +469,10 @@ def run_worker(args) -> int:
         codec=_codec_spec(args),
         tracer=tracer, telemetry=telemetry)
     fabric = bridge.make_fabric()
+    # death hooks armed before training: a SIGTERM'd worker leaves its
+    # flight dump for the postmortem merge even mid-iteration
+    ops = _make_ops(args, telemetry, role="worker")
+    ops.start()
 
     compressors = None
     if bridge.negotiated.codec_id != net.CODEC_NONE:
@@ -628,7 +657,8 @@ def run_worker(args) -> int:
         if t.is_alive():
             leftover.append(t.name)
     # dump BEFORE the potential os._exit below — a wedged thread must
-    # not cost the process its trace/metrics files
+    # not cost the process its trace/metrics/flight files
+    ops.close()
     _dump_telemetry(args, tracer, telemetry)
     rc = 0
     if errors:
@@ -737,6 +767,15 @@ def run_server_shard(args) -> int:
             print(f"shard {shard_id}: durable-log replay {counts}",
                   file=sys.stderr, flush=True)
 
+    # per-shard ops plane: the dump carries shard identity, so the
+    # postmortem merge can tell WHICH gate in the fleet wedged
+    ops = _make_ops(args, telemetry, role="server", shard=shard_id,
+                    meta={"shards": list(range(num_shards))})
+    ops.add_gate_watchdog(server)
+    if getattr(inner, "durable", False):
+        ops.add_fsync_watchdog()
+    ops.start()
+
     events: "queue.Queue[tuple[str, object]]" = queue.Queue()
     bridge.on_disconnect = lambda ids: events.put(("disconnect", ids))
     bridge.on_ready = lambda w: events.put(("ready", w))
@@ -832,6 +871,7 @@ def run_server_shard(args) -> int:
             print(f"shard {shard_id}: dropped rows "
                   f"{reroute['dropped']}, dropped sends "
                   f"{bridge.dropped_sends}", file=sys.stderr, flush=True)
+        ops.close()
         _dump_telemetry(args, tracer, telemetry)
     return 0
 
@@ -879,6 +919,12 @@ def _run_worker_sharded(args, addrs: list[str]) -> int:
     num_params = get_task(cfg.task, cfg.model).num_params
     plan = ShardPlan(num_params, len(addrs))
     tracer, telemetry = _make_telemetry(args)
+    # meta names the FULL shard roster: the postmortem analyzer's
+    # dead-shard detection is (known shards) - (shards that dumped),
+    # and the worker's dump is what survives when a shard is SIGKILL'd
+    ops = _make_ops(args, telemetry, role="worker",
+                    meta={"shards": list(range(len(addrs)))})
+    ops.start()
 
     def connect(addr: str, timeout: float = 30.0):
         host, _, port = addr.rpartition(":")
@@ -1042,6 +1088,7 @@ def _run_worker_sharded(args, addrs: list[str]) -> int:
     for t in [supervisor, ready_thread, *reader_threads]:
         if t.is_alive():
             leftover.append(t.name)
+    ops.close()                  # before any os._exit: the flight dump
     _dump_telemetry(args, tracer, telemetry)
     rc = 0
     if errors:
@@ -1098,6 +1145,10 @@ def run_replica(args) -> int:
         shed_deadline_s=shed_ms / 1000.0 if shed_ms else None,
         tracer=tracer, telemetry=telemetry)
     follower.catch_up()              # cold start: serve what's logged
+    ops = _make_ops(args, telemetry, role="replica")
+    ops.add_replica_watchdog()
+    ops.add_serving_watchdog(engine)
+    ops.start()
     port = getattr(args, "serve_port", None)
     bridge = net.ServerBridge(port=0 if port is None else port,
                               run_id=time.time_ns(), tracer=tracer,
@@ -1128,5 +1179,6 @@ def run_replica(args) -> int:
         follower.stop()
         engine.close()
         bridge.close()
+        ops.close()
         _dump_telemetry(args, tracer, telemetry)
     return 0

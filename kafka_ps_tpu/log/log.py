@@ -29,6 +29,7 @@ import os
 import time
 
 from kafka_ps_tpu.log.segment import LogSegment, segment_basename
+from kafka_ps_tpu.telemetry.flight import FLIGHT
 from kafka_ps_tpu.utils.trace import NULL_TRACER
 
 
@@ -109,6 +110,9 @@ class CommitLog:
         self.tracer.count("log.appends")
         if self.telemetry.enabled:
             self._m_appends.inc()
+        if FLIGHT.enabled:
+            FLIGHT.record("log.append", log=self.name, offset=offset,
+                          bytes=len(payload))
         self._maybe_fsync()
         return offset
 
@@ -136,11 +140,16 @@ class CommitLog:
         """The single sync-flush site: the fsync stall IS the durability
         tax --log-fsync buys, so its latency distribution is a first-
         class metric (docs/DURABILITY.md trade-off table)."""
+        FLIGHT.enter("log.fsync")      # watchdog sees a wedged syscall
         t0 = time.perf_counter()
         self.active.flush(sync=True)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        FLIGHT.exit("log.fsync")
         self.tracer.count("log.fsyncs")
         if self.telemetry.enabled:
-            self._m_fsync_ms.observe((time.perf_counter() - t0) * 1e3)
+            self._m_fsync_ms.observe(dt_ms)
+        if FLIGHT.enabled:
+            FLIGHT.record("log.fsync", log=self.name, ms=round(dt_ms, 3))
 
     def flush(self) -> None:
         """Force an fsync of the active segment regardless of policy —

@@ -83,6 +83,7 @@ from kafka_ps_tpu.compress.wire import CODEC_NONE, CodecSpec
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime import serde
 from kafka_ps_tpu.telemetry import NULL_TELEMETRY
+from kafka_ps_tpu.telemetry.flight import FLIGHT
 from kafka_ps_tpu.utils.trace import NULL_TRACER
 
 _FRAME = struct.Struct("<IBq")          # length, topic, key
@@ -502,6 +503,11 @@ class ServerBridge:
                 frames, nbytes = self._m_sent[topic]
                 frames.inc()
                 nbytes.inc(_FRAME.size + len(payload))
+            if FLIGHT.enabled and topic in (T_WEIGHTS, T_GRADIENTS):
+                # only the data-plane topics: a PING every few seconds
+                # would evict the interesting events from a quiet ring
+                FLIGHT.record("net.send", topic=TOPIC_NAMES[topic],
+                              peer=key, bytes=len(payload))
             return True
         except (ConnectionError, OSError):
             self.dropped_sends += count
@@ -595,6 +601,8 @@ class ServerBridge:
                         for w in ids:
                             self._conn_of[w] = conn
                         self._cv.notify_all()
+                    if FLIGHT.enabled:
+                        FLIGHT.record("net.hello", workers=list(ids))
                     if self.on_hello is not None:
                         self.on_hello(list(ids))
                 elif topic == T_READY:
@@ -615,6 +623,11 @@ class ServerBridge:
                             payload, len(payload) - _TRACE_CTX.size)
                         payload = payload[:len(payload) - _TRACE_CTX.size]
                     msg = serde.from_bytes(payload)
+                    if FLIGHT.enabled:
+                        FLIGHT.record(
+                            "net.recv", topic="gradients",
+                            worker=getattr(msg, "worker_id", key),
+                            clock=getattr(msg, "vector_clock", -1))
                     if fid is not None:
                         with self._tracer.span("net.recv",
                                                topic="gradients"):
@@ -695,6 +708,8 @@ class ServerBridge:
             self._codec_of.pop(conn, None)
             self._trace_of.pop(conn, None)
             self._cv.notify_all()
+        if FLIGHT.enabled and ids:
+            FLIGHT.record("net.disconnect", workers=ids)
         if ids and not self._stop.is_set() and self.on_disconnect is not None:
             self.on_disconnect(ids)
 
@@ -821,6 +836,11 @@ class WorkerBridge:
             frames, nbytes = self._m_sent[T_GRADIENTS]
             frames.inc()
             nbytes.inc(_FRAME.size + len(payload))
+        if FLIGHT.enabled:
+            FLIGHT.record("net.send", topic="gradients",
+                          worker=getattr(message, "worker_id", key),
+                          clock=getattr(message, "vector_clock", -1),
+                          bytes=len(payload))
 
     def make_fabric(self) -> fabric_mod.Fabric:
         """Local fabric whose GRADIENTS sends cross the socket (the
@@ -925,6 +945,10 @@ class WorkerBridge:
                 if topic == T_DATA:
                     buffers[key].add(msg.features, msg.label)
                 elif topic == T_WEIGHTS:
+                    if FLIGHT.enabled:
+                        FLIGHT.record(
+                            "net.weights_recv", worker=key,
+                            clock=getattr(msg, "vector_clock", -1))
                     if fid is not None:
                         # close the weights flow on the receiving slice
                         with self._tracer.span("net.recv",

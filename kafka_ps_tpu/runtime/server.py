@@ -43,6 +43,7 @@ from kafka_ps_tpu.runtime.messages import (GangNotice, GradientMessage,
                                            KeyRange, WeightsMessage)
 from kafka_ps_tpu.telemetry import (CLOCK_BUCKETS, NULL_TELEMETRY,
                                     model_name)
+from kafka_ps_tpu.telemetry.flight import FLIGHT
 from kafka_ps_tpu.utils import asynclog
 from kafka_ps_tpu.utils.config import EVENTUAL, PSConfig
 from kafka_ps_tpu.utils.trace import NULL_TRACER
@@ -274,6 +275,10 @@ class ServerNode:
         self.weights_sent_at[worker] = time.monotonic()
         self.tracker.sent_message(worker, clock)
         self._observe_gate_release(worker)
+        if FLIGHT.enabled:
+            FLIGHT.record("gate.release", shard=self.shard_id,
+                          worker=worker, clock=clock)
+            FLIGHT.beat("gate")
 
     def _observe_gate_release(self, worker: int) -> None:
         """Gate-wait sample: how long this worker's gradient sat at the
@@ -286,6 +291,15 @@ class ServerNode:
         arrived = self._grad_arrived.pop(worker, None)
         if arrived is not None:
             self._m_gate_wait.observe((time.perf_counter() - arrived) * 1e3)
+
+    def gate_waiting(self) -> int:
+        """How many active workers are currently parked at the gate
+        (gradient received, reply withheld) — the demand predicate the
+        gate watchdog checks liveness against (telemetry/health.py).
+        Host ints only; safe from any thread (racy reads see a
+        consistent-enough count)."""
+        return sum(1 for w in self.tracker.active_workers
+                   if not self.tracker.tracker[w].weights_message_sent)
 
     # -- consistency gate (ServerProcessor.java:95-134) --------------------
 
@@ -411,6 +425,9 @@ class ServerNode:
         if self.telemetry.enabled:
             self._m_snapshots.inc()
             self._m_serving_clock.set(clock)
+        if FLIGHT.enabled:
+            FLIGHT.record("snapshot.publish", shard=self.shard_id,
+                          clock=int(clock))
 
     # -- the hot path (ServerProcessor.java:143-183) -----------------------
 
@@ -433,6 +450,8 @@ class ServerNode:
         self.tracer.count("server.gradients_applied")
         if self.telemetry.enabled:
             self._observe_arrival(msg.worker_id, msg.vector_clock)
+        if FLIGHT.enabled:
+            self._flight_arrival(msg.worker_id, msg.vector_clock)
         fid = getattr(msg, "trace", None)
         self._pending_trace = fid
 
@@ -552,6 +571,22 @@ class ServerNode:
             self._sparse_apply_cache[bucket] = fn
         return fn
 
+    def _flight_arrival(self, worker: int, clock: int) -> None:
+        """Flight-recorder view of one gradient arrival: the full vector
+        clock at gate-decision time (list index = worker id, evicted
+        workers' clocks frozen where they stopped) plus this worker's
+        lag — all host ints read off the tracker (no device values,
+        PS106).  Kept to a flat int list: this runs per gradient, and
+        the flight_overhead bench gates it at < 2% of server iters/s."""
+        states = self.tracker.tracker
+        clocks = [s.vector_clock for s in states]
+        waiting = sum(1 for s in states
+                      if s.active and not s.weights_message_sent)
+        FLIGHT.record("gate.arrive", shard=self.shard_id, worker=worker,
+                      clock=clock, lag=max(clocks) - clock,
+                      waiting=waiting, clocks=clocks)
+        FLIGHT.beat("gate")
+
     def _observe_arrival(self, worker: int, clock: int) -> None:
         """Per-gradient consistency observations, all host integers:
         arrival stamp (gate-wait baseline), this worker's clock lag
@@ -633,6 +668,8 @@ class ServerNode:
             self.tracer.count("server.gradients_applied")
             if self.telemetry.enabled:
                 self._observe_arrival(m.worker_id, m.vector_clock)
+            if FLIGHT.enabled:
+                self._flight_arrival(m.worker_id, m.vector_clock)
             if (m.worker_id == 0 and self.test_x is not None
                     and m.vector_clock % self.cfg.eval_every == 0):
                 eval_positions.append(i)
@@ -758,6 +795,10 @@ class ServerNode:
                            values=theta, encoded=encoded))
         self.weights_sent_at[worker] = time.monotonic()
         self._observe_gate_release(worker)
+        if FLIGHT.enabled:
+            FLIGHT.record("gate.release", shard=self.shard_id,
+                          worker=worker, clock=clock, gang=True)
+            FLIGHT.beat("gate")
 
     def maybe_checkpoint(self) -> None:
         """Save once every `checkpoint_every` applied iterations —

@@ -45,6 +45,7 @@ from kafka_ps_tpu.runtime.messages import (GradientMessage, KeyRange,
                                            SparseDeltaMessage,
                                            WeightsMessage)
 from kafka_ps_tpu.runtime.server import ServerNode
+from kafka_ps_tpu.telemetry.flight import FLIGHT
 
 
 class ShardPlan:
@@ -171,10 +172,17 @@ class ShardRouter:
         pass, and its (worker, clock) duplicate filter drops whatever
         originally got through, so resending is always safe."""
         sent = False
+        count = 0
         for c in sorted(self._cache):
             if c >= clock:
                 self._send(shard_id, self._cache[c][shard_id])
                 sent = True
+                count += 1
+        if FLIGHT.enabled:
+            # host ints only, and the recorder stamps time internally —
+            # the routing path itself stays wall-clock-free (PS104)
+            FLIGHT.record("router.resend", shard=shard_id,
+                          from_clock=clock, count=count)
         return sent
 
 
@@ -201,6 +209,11 @@ class WeightsAssembler:
               msg: WeightsMessage) -> bool:
         """Feed one shard's slice; returns True when this completed an
         assembly and the full message was delivered."""
+        if FLIGHT.enabled:
+            # the per-shard weights ack trail postmortem's "last
+            # (worker, clock) the dead shard served" is computed from
+            FLIGHT.record("shard.weights", shard=shard_id, worker=worker,
+                          clock=msg.vector_clock)
         last = self._delivered.get(worker, -1)
         if msg.vector_clock <= last:
             if self._resend is not None:

@@ -52,6 +52,7 @@ from kafka_ps_tpu.analysis.lockgraph import OrderedLock
 from kafka_ps_tpu.serving import policy
 from kafka_ps_tpu.serving.snapshot import SnapshotRegistry
 from kafka_ps_tpu.telemetry import NULL_TELEMETRY
+from kafka_ps_tpu.telemetry.flight import FLIGHT
 from kafka_ps_tpu.utils.trace import NULL_TRACER, LatencyRecorder
 
 
@@ -275,6 +276,11 @@ class PredictionEngine:
             if stop:
                 return
 
+    def queue_depth(self) -> int:
+        """Admitted-but-unserved requests right now (host int; the
+        serving watchdog's demand predicate, telemetry/health.py)."""
+        return self._depth
+
     def _serve(self, batch: list[_Request]) -> None:
         self.requests += len(batch)
         with self._admission:
@@ -283,6 +289,10 @@ class PredictionEngine:
             self._depth -= len(batch)
             if self.telemetry.enabled:
                 self._m_queue_depth.set(self._depth)
+        if FLIGHT.enabled:
+            FLIGHT.record("serving.batch", n=len(batch),
+                          depth=self._depth)
+            FLIGHT.beat("serving")
         if self.telemetry.enabled:
             self._m_requests.inc(len(batch))
         # group by tenant, preserving arrival order within each group:

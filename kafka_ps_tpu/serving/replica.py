@@ -40,6 +40,7 @@ from kafka_ps_tpu.log.tail import TopicTailer
 from kafka_ps_tpu.runtime import serde
 from kafka_ps_tpu.serving.snapshot import (FrontierCutPublisher,
                                            SnapshotRegistry)
+from kafka_ps_tpu.telemetry.flight import FLIGHT
 
 _SHARD_DIR = re.compile(r"^shard(\d+)of(\d+)$")
 
@@ -130,6 +131,11 @@ class ReplicaFollower:
             self.publications += 1
             if self.tracer is not None:
                 self.tracer.count("replica.publications")
+            if FLIGHT.enabled:
+                latest = self.registry.latest
+                FLIGHT.record("replica.publish",
+                              clock=(latest.vector_clock
+                                     if latest is not None else -1))
         return published
 
     @property
@@ -150,6 +156,10 @@ class ReplicaFollower:
     def _follow(self) -> None:
         while not self._stop.is_set():
             self.catch_up()
+            # beat every poll, data or not: the replica watchdog's
+            # question is "is the tail loop turning?", not "is the
+            # trainer producing?" (telemetry/health.py)
+            FLIGHT.beat("replica")
             self._stop.wait(self.poll_interval_s)
 
     def stop(self, timeout: float = 10.0) -> None:

@@ -1,7 +1,10 @@
 """End-to-end telemetry plane (docs/OBSERVABILITY.md): metrics
 registry + cross-process causal tracing glue over the utils/trace.py
-and utils/status.py backends."""
+and utils/status.py backends, plus the black-box flight recorder /
+watchdog / postmortem plane (telemetry/flight.py, health.py,
+postmortem.py)."""
 
+from kafka_ps_tpu.telemetry.flight import FLIGHT, FlightRecorder
 from kafka_ps_tpu.telemetry.registry import (CLOCK_BUCKETS,
                                              LATENCY_BUCKETS_MS,
                                              NULL_TELEMETRY, Counter,
@@ -9,6 +12,7 @@ from kafka_ps_tpu.telemetry.registry import (CLOCK_BUCKETS,
                                              MetricsRegistry, Telemetry,
                                              maybe_telemetry, model_name)
 
-__all__ = ["CLOCK_BUCKETS", "LATENCY_BUCKETS_MS", "NULL_TELEMETRY",
+__all__ = ["CLOCK_BUCKETS", "FLIGHT", "FlightRecorder",
+           "LATENCY_BUCKETS_MS", "NULL_TELEMETRY",
            "Counter", "Gauge", "Histogram", "MetricsRegistry",
            "Telemetry", "maybe_telemetry", "model_name"]

@@ -4,6 +4,12 @@ Subcommands:
   merge -o OUT in1.json in2.json ...
       Stitch per-process --trace files from a socket-mode run into one
       Chrome/Perfetto trace (docs/OBSERVABILITY.md walkthrough).
+  postmortem DIR
+      Merge the flight dumps (flightdump-<pid>.json, --flight-dir) a
+      multi-process run left behind and name the culprit: dead shards,
+      the last (worker, clock) each dead shard acknowledged, watchdog
+      trips, gate-stall evidence (docs/OBSERVABILITY.md, "Flight
+      recorder & postmortem").
 """
 
 from __future__ import annotations
@@ -12,6 +18,7 @@ import argparse
 import sys
 
 from kafka_ps_tpu.telemetry.merge import merge_traces
+from kafka_ps_tpu.telemetry.postmortem import main as postmortem_main
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -23,6 +30,11 @@ def build_parser() -> argparse.ArgumentParser:
                        help="merged Chrome trace output path")
     merge.add_argument("inputs", nargs="+",
                        help="per-process trace files (Tracer.dump output)")
+    post = sub.add_parser(
+        "postmortem",
+        help="analyze a directory of flight dumps and name the culprit")
+    post.add_argument("dir", help="directory holding flightdump-*.json "
+                                  "(the run's --flight-dir)")
     return parser
 
 
@@ -34,6 +46,8 @@ def main(argv=None) -> int:
               f"-> {args.out} (pids {stats['pids']}, "
               f"{stats['cross_process_flows']} cross-process flows)")
         return 0
+    if args.cmd == "postmortem":
+        return postmortem_main(args.dir)
     return 2
 
 

@@ -153,6 +153,14 @@ class Histogram:
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition-format label-value escaping: backslash
+    FIRST (it is the escape character), then quote and newline."""
+    return (v.replace("\\", r"\\")
+             .replace('"', r'\"')
+             .replace("\n", r"\n"))
+
+
 class _Family:
     """One named metric family: children keyed by label-value tuples."""
 
@@ -252,7 +260,10 @@ class MetricsRegistry:
         return out
 
     def prometheus_text(self) -> str:
-        """Prometheus text exposition format (one dump, no timestamps)."""
+        """Prometheus text exposition format (one dump, no timestamps).
+        Label VALUES are escaped per the format (backslash, double
+        quote, newline) — a `--connect` address or file path with a
+        quote in it must not produce an unparseable exposition."""
         lines: list[str] = []
         for name, fam in sorted(self.families().items()):
             if fam.help:
@@ -260,7 +271,8 @@ class MetricsRegistry:
             lines.append(f"# TYPE {name} {fam.kind}")
             for key, child in sorted(fam.children().items()):
                 label = ",".join(
-                    f'{n}="{v}"' for n, v in zip(fam.label_names, key))
+                    f'{n}="{_escape_label_value(v)}"'
+                    for n, v in zip(fam.label_names, key))
                 if fam.kind == "histogram":
                     counts, hsum, total = child.state()
                     cum = 0

@@ -37,20 +37,27 @@
 # training process serving over a socket (--serve --serve_port
 # --serve-queue) driven by THIS process's load generator — zero
 # deadline violations at low rate, >=1 explicit typed shed under a
-# flash crowd, and the trained theta bitwise-identical to a no-load
-# run (docs/SERVING.md, "Operating at load").
+# flash crowd, an offered-rate Poisson arm (open loop, latency from
+# scheduled arrival) answering within the smoke SLO with zero errors,
+# and the trained theta bitwise-identical to a no-load run
+# (docs/SERVING.md, "Operating at load").
 #
 # `scripts/tier1.sh --analyze` runs the static-analysis leg: pscheck
 # (docs/ANALYSIS.md) over the package — fails on ANY unsuppressed
 # finding — plus ruff (pyproject.toml, rule sets E/F/B/PLE) when the
 # binary is installed.
 #
-# `scripts/tier1.sh --obs` runs the observability smoke leg: a short
-# socket-bridged run with tracing and metrics on (two tracers with
-# distinct pids standing in for the `--listen --trace` / `--connect
-# --trace` processes), asserting the merged trace contains >= 1
-# cross-process flow and the Prometheus dump parses with the staleness
-# histogram families populated (docs/OBSERVABILITY.md).
+# `scripts/tier1.sh --obs` runs the observability smoke leg in two
+# phases (docs/OBSERVABILITY.md): (1) a short socket-bridged run with
+# tracing and metrics on (two tracers with distinct pids standing in
+# for the `--listen --trace` / `--connect --trace` processes),
+# asserting the merged trace contains >= 1 cross-process flow and the
+# Prometheus dump parses with the staleness histogram families
+# populated; (2) a subprocess fleet (2 shard servers + 1 worker, all
+# with --flight-dir) where shard 1 is SIGKILLed mid-run — the
+# survivors' flight dumps must exist, the killed shard's must not, and
+# `python -m kafka_ps_tpu.telemetry postmortem` must exit 0 naming the
+# dead shard and its last acknowledged weights send (POSTMORTEM_OK).
 set -o pipefail
 
 if [[ "${1:-}" == "--analyze" ]]; then
@@ -94,11 +101,13 @@ for path, (xx, yy) in ((train, (x[:200], y[:200])),
 
 env = dict(os.environ, JAX_PLATFORMS="cpu",
            PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
-# sized so training ALWAYS outlasts the ~9 s load window: ~450
-# unloaded iters/s on the reference 1-core box -> ~16 s floor, and the
-# load itself only slows the trainer down; liveness asserts below turn
-# a too-fast trainer into a clear failure instead of an error storm
-MAX_IT = 7200
+# sized so training ALWAYS outlasts the ~10 s load window (warmup +
+# low + flash crowd + poisson): ~1500 unloaded iters/s on a fast box
+# -> ~11 s floor even before the load slows the trainer down (~450
+# iters/s on the reference 1-core box -> ~36 s); liveness asserts
+# below turn a too-fast trainer into a clear failure instead of an
+# error storm
+MAX_IT = 16000
 common = ["-training", train, "-test", test, "--num_workers", "2",
           "--num_features", "8", "--num_classes", "2", "-min", "8",
           "-max", "32", "-p", "2", "-c", "0", "--mode", "serial",
@@ -146,6 +155,16 @@ try:
     # shed EXPLICITLY (typed PREDICT_OVERLOADED), never time out
     over = loadgen.run_closed_loop(target, 8, concurrency=32,
                                    duration_s=3.0)
+    # offered-rate arm: memoryless Poisson arrivals at a modest rate —
+    # the steady-state traffic model (bench.py serving_load quotes its
+    # SLO against this shape).  Latency counts from the SCHEDULED
+    # arrival (no coordinated omission), so the smoke SLO here also
+    # covers queueing behind the shared training core.  Sheds are
+    # legal (bursts can momentarily fill the 4-deep queue); errors are
+    # not — every rejection must be typed.
+    pois = loadgen.run_open_loop(target, 8, rate_qps=40.0,
+                                 duration_s=2.5, concurrency=8,
+                                 arrivals="poisson")
     # the whole point is load DURING training: if the trainer already
     # exited, the run above measured a dead socket, not admission
     assert proc.poll() is None, \
@@ -158,6 +177,9 @@ assert rc == 0, f"serving arm rc={rc}\n{err[-4000:]}"
 assert low.meets(500.0), f"low-rate SLO violated: {low.as_dict()}"
 assert over.shed >= 1, f"flash crowd never shed: {over.as_dict()}"
 assert over.errors == 0, f"sheds must be typed: {over.as_dict()}"
+assert pois.errors == 0, f"poisson arm errored: {pois.as_dict()}"
+assert pois.ok > 0, f"poisson arm answered nothing: {pois.as_dict()}"
+assert pois.p99_ms <= 500.0, f"poisson SLO violated: {pois.as_dict()}"
 
 quiet, _, quiet_ckpt = arm(serve=False)
 rc = quiet.wait(timeout=240)
@@ -170,6 +192,8 @@ assert ts.tobytes() == tq.tobytes(), \
     "read load perturbed training theta"
 print(f"LOAD_SMOKE_OK low_p99_ms={low.p99_ms} low_ok={low.ok} "
       f"sheds={over.shed} shed_rate={over.shed_rate:.3f} "
+      f"poisson_p99_ms={pois.p99_ms} poisson_ok={pois.ok} "
+      f"poisson_shed={pois.shed} "
       f"theta=bitwise-identical iters={MAX_IT}")
 EOF
     exit $?
@@ -329,7 +353,7 @@ EOF
 fi
 
 if [[ "${1:-}" == "--obs" ]]; then
-    timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
+    timeout -k 10 540 env JAX_PLATFORMS=cpu python - <<'EOF'
 import tempfile
 import threading
 from pathlib import Path
@@ -429,6 +453,125 @@ assert snap["gate_wait_ms"]["model=bounded"]["count"] > 0, snap
 print(f"OBS_SMOKE_OK flows={stats['cross_process_flows']} "
       f"events={stats['events']} pids={sorted(stats['pids'])} "
       f"metric_families={len(snap)}")
+
+# ---- phase 2: black-box postmortem of a SIGKILLed shard --------------
+# A real split-deployment fleet (2 shard servers + 1 worker process, the
+# --shard leg's topology) runs with --flight-dir; shard 1 is SIGKILLed
+# mid-run — it writes NO dump, and that absence is the finding.  The
+# survivors' death-hook/shutdown dumps are merged by the postmortem CLI,
+# which must name the dead shard and its last acknowledged weights send.
+import glob
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+proot = tempfile.mkdtemp(prefix="kps-postmortem-")
+flight = os.path.join(proot, "flight")
+repo = os.getcwd()
+prng = np.random.default_rng(0)
+px = prng.normal(size=(256, 8)).astype(np.float32)
+py = (px[:, 0] > 0).astype(np.int32) + 1
+ptrain = os.path.join(proot, "train.csv")
+ptest = os.path.join(proot, "test.csv")
+for path, (xx, yy) in ((ptrain, (px[:200], py[:200])),
+                       (ptest, (px[200:], py[200:]))):
+    with open(path, "w") as fh:
+        fh.write(",".join(f"f{i}" for i in range(8)) + ",Score\n")
+        for r, lab in zip(xx, yy):
+            fh.write(",".join(f"{v:.6f}" for v in r) + f",{lab}\n")
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+p0, p1 = free_port(), free_port()
+penv = dict(os.environ, JAX_PLATFORMS="cpu",
+            PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+# the fleet is killed mid-run; MAX_IT only has to outlast the kill point
+MAX_IT = 5000
+pcommon = ["--num_workers", "2", "--num_features", "8",
+           "--num_classes", "2", "--max_iterations", str(MAX_IT),
+           "--flight-dir", flight]
+logdir = os.path.join(proot, "log")
+
+def pshard(i, port):
+    return subprocess.Popen(
+        [sys.executable, "-m", "kafka_ps_tpu.cli.server_runner",
+         "--listen", str(port), "--shards", "2", "--shard-id", str(i),
+         "-training", ptrain, "-test", ptest, "-p", "5", "-c", "0",
+         "--durable-log", logdir,
+         "--checkpoint", os.path.join(proot, "ckpt.npz"),
+         "--checkpoint_every", "50", *pcommon],
+        env=penv, cwd=proot, stderr=subprocess.PIPE,
+        stdout=subprocess.DEVNULL, text=True)
+
+s0, s1 = pshard(0, p0), pshard(1, p1)
+w = subprocess.Popen(
+    [sys.executable, "-m", "kafka_ps_tpu.cli.worker_runner",
+     "--connect", f"127.0.0.1:{p0},127.0.0.1:{p1}",
+     "--worker_ids", "0,1", "-test", ptest,
+     "-min", "8", "-max", "32", *pcommon],
+    env=penv, cwd=proot, stderr=subprocess.PIPE,
+    stdout=subprocess.DEVNULL, text=True)
+
+# wait until shard 1 has served real traffic (its gradient log has a
+# prefix of slices — so every surviving ring holds shard-1 evidence),
+# then SIGKILL it: no handler runs, no dump is written
+grad_glob = os.path.join(logdir, "shard1of2", "gradients", "*", "*.log")
+deadline = time.monotonic() + 120
+while time.monotonic() < deadline:
+    segs = glob.glob(grad_glob)
+    if segs and sum(os.path.getsize(s) for s in segs) > 8000:
+        break
+    if s1.poll() is not None:
+        print(s1.stderr.read(), file=sys.stderr)
+        raise SystemExit("shard1 exited before the kill point")
+    time.sleep(0.1)
+else:
+    raise SystemExit("shard1 gradient log never grew")
+os.kill(s1.pid, signal.SIGKILL)
+s1.wait()
+time.sleep(1.0)
+
+# SIGTERM the survivors: the flight recorder's death hook dumps the
+# rings then re-raises, so each leaves flightdump-<pid>.json behind
+# (a survivor that already noticed the dead peer and exited through
+# its normal path dumped on OpsPlane.close instead — either way the
+# evidence is on disk; exit codes are NOT asserted here)
+for p in (w, s0):
+    if p.poll() is None:
+        p.send_signal(signal.SIGTERM)
+for p in (w, s0):
+    try:
+        p.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        raise SystemExit("survivor ignored SIGTERM")
+
+dumps = sorted(glob.glob(os.path.join(flight, "flightdump-*.json")))
+pids = {int(os.path.basename(d).split("-")[1].split(".")[0])
+        for d in dumps}
+assert s0.pid in pids, f"shard0 left no dump: {dumps}"
+assert w.pid in pids, f"worker left no dump: {dumps}"
+assert s1.pid not in pids, "SIGKILLed shard must not have dumped"
+
+pm = subprocess.run(
+    [sys.executable, "-m", "kafka_ps_tpu.telemetry", "postmortem",
+     flight], env=penv, cwd=proot, capture_output=True, text=True,
+    timeout=120)
+assert pm.returncode == 0, f"postmortem rc={pm.returncode}\n{pm.stderr}"
+assert "dead shard 1" in pm.stdout, pm.stdout
+assert "last ack from shard 1" in pm.stdout, pm.stdout
+print(f"POSTMORTEM_OK dumps={len(dumps)} dead_shard=1 "
+      f"survivors={sorted(pids)}")
 EOF
     exit $?
 fi

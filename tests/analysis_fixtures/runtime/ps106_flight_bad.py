@@ -1,0 +1,9 @@
+"""PS106 positive (flight-recorder scope): a FLIGHT.record call whose
+event fields force a host sync — the device value is fetched inside the
+recording arguments, so the "near-zero cost when idle" recorder would
+stall the hot path it observes."""
+
+
+def on_release(flight, worker, clock, theta):
+    flight.record("gate.release", worker=worker, clock=clock,
+                  norm=float(theta))

@@ -1,0 +1,10 @@
+"""PS106 negative (flight-recorder scope): flight events carry only
+host ints the hot path already owns — worker ids, clocks, byte counts;
+the recorder stamps time internally (telemetry/flight.py)."""
+
+
+def on_release(flight, worker, clock, payload):
+    if flight.enabled:
+        flight.record("gate.release", worker=worker, clock=clock,
+                      bytes=len(payload))
+    flight.beat("gate")

@@ -35,6 +35,7 @@ def _findings(relpath: str):
     ("serving/ps102_bad.py", "PS102"),
     ("serving/ps105_bad.py", "PS105"),
     ("runtime/ps106_bad.py", "PS106"),
+    ("runtime/ps106_flight_bad.py", "PS106"),
 ])
 def test_positive_fixture_triggers_exactly_once(relpath, rule):
     found = _findings(relpath)
@@ -53,6 +54,7 @@ def test_positive_fixture_triggers_exactly_once(relpath, rule):
     "serving/ps102_ok.py",
     "serving/ps105_ok.py",
     "runtime/ps106_ok.py",
+    "runtime/ps106_flight_ok.py",
 ])
 def test_negative_fixture_stays_clean(relpath):
     assert _findings(relpath) == []

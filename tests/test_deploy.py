@@ -209,6 +209,33 @@ def test_dockerfile_references_exist():
     assert importlib.util.find_spec("kafka_ps_tpu.cli.run")
 
 
+@pytest.mark.parametrize("relpath", K8S_MANIFESTS)
+def test_k8s_health_probes_target_the_health_plane(relpath):
+    """Every workload container wires --health-port and points its
+    probes at /healthz on that port (telemetry/health.py), without
+    displacing the serve/listen port from ports[0]."""
+    wired = 0
+    for d in _load(relpath):
+        if d["kind"] not in ("Job", "Deployment"):
+            continue
+        for c in _containers(d):
+            args = c.get("args", [])
+            assert "--health-port" in args, \
+                f"{relpath}: {c['name']} has no health plane"
+            port = int(args[args.index("--health-port") + 1])
+            probes = [c.get(k) for k in ("livenessProbe",
+                                         "readinessProbe")]
+            assert any(probes), f"{relpath}: {c['name']} has no probe"
+            for p in probes:
+                if p is not None:
+                    assert p["httpGet"]["path"] == "/healthz"
+                    assert p["httpGet"]["port"] == port
+            assert any(pp["containerPort"] == port
+                       for pp in c["ports"])
+            wired += 1
+    assert wired > 0
+
+
 # -- tool smoke (skipped where the binary is absent) -------------------------
 
 kubectl = shutil.which("kubectl")

@@ -1,0 +1,448 @@
+"""Flight recorder, watchdogs, health plane, and postmortem analyzer
+(kafka_ps_tpu/telemetry/{flight,health,postmortem}.py).
+
+The watchdog tests PIN the threshold semantics docs/OBSERVABILITY.md
+promises: a watchdog trips iff demand has been continuously true AND no
+progress beat arrived for more than threshold_s; beats restart the
+window (a slow-but-alive BSP round never trips); demand dropping clears
+both the window and the trip."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime.app import StreamingPSApp
+from kafka_ps_tpu.telemetry import (FLIGHT, FlightRecorder,
+                                    MetricsRegistry, Telemetry)
+from kafka_ps_tpu.telemetry import postmortem
+from kafka_ps_tpu.telemetry.flight import DUMP_SCHEMA
+from kafka_ps_tpu.telemetry.health import (Liveness, OpsPlane,
+                                           WatchdogPanel)
+from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
+                                       PSConfig, StreamConfig)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _global_flight_reset():
+    """Tests that drive real instrumentation arm the process-global
+    FLIGHT; never leak an armed recorder into the next test."""
+    yield
+    FLIGHT.disable()
+
+
+# -- the ring ---------------------------------------------------------------
+
+def test_ring_wraps_and_keeps_last():
+    fr = FlightRecorder(capacity=8)
+    fr.enable(role="test")
+    for i in range(20):
+        fr.record("tick", i=i)
+    events = fr.tail(100)
+    assert [e["i"] for e in events] == list(range(12, 20))  # last 8
+    assert fr.total_events() == 20          # wrap counts lifetime appends
+    assert all(e["kind"] == "tick" for e in events)
+    assert events[0]["t"] <= events[-1]["t"]
+    fr.disable()
+
+
+def test_disarmed_recorder_is_a_noop():
+    fr = FlightRecorder(capacity=8)
+    fr.record("tick", i=1)
+    fr.beat("gate")
+    assert fr.tail(10) == []
+    assert fr.total_events() == 0
+    assert fr.last_beat("gate") is None
+
+
+def test_tail_merges_threads_in_time_order():
+    fr = FlightRecorder(capacity=32)
+    fr.enable(role="test")
+
+    def worker():
+        for i in range(5):
+            fr.record("other", i=i)
+
+    t = threading.Thread(target=worker, name="ring-peer")
+    fr.record("mine", i=0)
+    t.start()
+    t.join()
+    fr.record("mine", i=1)
+    events = fr.tail(100)
+    assert len(events) == 7
+    assert [e["t"] for e in events] == sorted(e["t"] for e in events)
+    assert {e["thread"] for e in events} >= {"ring-peer"}
+    fr.disable()
+
+
+def test_dump_schema_roundtrip(tmp_path):
+    fr = FlightRecorder(capacity=16)
+    fr.enable(role="server", shard=3, flight_dir=str(tmp_path),
+              meta={"shards": [0, 3]})
+    fr.record("gate.arrive", shard=3, worker=1, clock=5, lag=0,
+              waiting=2, clocks=[5, 5, 4, 5])
+    fr.beat("gate")
+    fr.enter("log.fsync")
+    path = fr.dump(reason="test")
+    assert path == str(tmp_path / f"flightdump-{os.getpid()}.json")
+    d = json.loads(Path(path).read_text())
+    assert d["schema"] == DUMP_SCHEMA
+    assert d["pid"] == os.getpid()
+    assert (d["role"], d["shard"]) == ("server", 3)
+    assert d["meta"] == {"shards": [0, 3]}
+    assert d["reason"] == "test"
+    assert d["events"][0]["kind"] == "gate.arrive"
+    assert d["events"][0]["clocks"] == [5, 5, 4, 5]
+    assert "gate" in d["beats"]
+    assert d["inflight"]["log.fsync"] >= 0.0
+    assert "MainThread" in d["threads"]       # every thread's stack
+    for key in ("wallClockT0", "dumpedAt", "lockEdges", "metrics",
+                "watchdogs"):
+        assert key in d
+    fr.disable()
+
+
+# -- watchdog semantics (PINNED) -------------------------------------------
+
+def test_watchdog_beats_restart_the_window():
+    """The false-positive contract: with demand continuously true, a
+    beat stream faster than threshold_s keeps the dog quiet forever;
+    silence longer than threshold_s past the LAST beat trips it; the
+    next beat un-trips it."""
+    fr = FlightRecorder()
+    fr.enable(role="test")
+    dog = Liveness("gate", 1.0, demand=lambda: True, flight=fr)
+    t0 = time.monotonic()
+    # first check stamps demand_since; no beat yet, armed-at fallback
+    assert dog.check(now=t0) is False
+    assert dog.check(now=t0 + 0.9) is False
+    fr.beat("gate")
+    b = fr.last_beat("gate")
+    assert b >= t0                         # window restarts at the beat
+    for dt in (0.3, 0.6, 0.99):            # sleepy but alive
+        assert dog.check(now=b + dt) is False
+    assert dog.check(now=b + 1.01) is True
+    assert dog.trip_count == 1
+    assert "no progress" in dog.last_reason
+    fr.beat("gate")
+    assert dog.check(now=fr.last_beat("gate") + 0.1) is False  # un-trip
+    assert dog.trip_count == 1             # edges, not polls
+    fr.disable()
+
+
+def test_watchdog_demand_drop_clears_window_and_trip():
+    fr = FlightRecorder()
+    fr.enable(role="test")
+    demanded = {"v": True}
+    dog = Liveness("serving", 0.5, demand=lambda: demanded["v"],
+                   flight=fr)
+    t0 = time.monotonic()
+    assert dog.check(now=t0) is False              # stamps demand_since
+    assert dog.check(now=t0 + 1.0) is True         # stalled with demand
+    demanded["v"] = False
+    assert dog.check(now=t0 + 2.0) is False        # recovery un-trips
+    demanded["v"] = True
+    # the stall window restarts at the demand edge, not at t0
+    assert dog.check(now=t0 + 2.2) is False
+    assert dog.check(now=t0 + 2.8) is True
+    fr.disable()
+
+
+def _bsp_app():
+    cfg = PSConfig(num_workers=4, consistency_model=0,
+                   model=ModelConfig(num_features=8, num_classes=2),
+                   buffer=BufferConfig(min_size=8, max_size=32),
+                   stream=StreamConfig(time_per_event_ms=1.0))
+    app = StreamingPSApp(cfg)
+    import numpy as np
+    rng = np.random.default_rng(0)
+    for i in range(64):
+        app.data_sink(i % 4, {j: float(rng.normal()) for j in range(8)},
+                      int(rng.integers(1, 3)))
+    return app
+
+
+def test_sleepy_bsp_round_does_not_trip_gate_watchdog():
+    """The satellite false-positive scenario: a BSP round where one
+    worker straggles.  Three gradients arrive (each beating "gate"),
+    three workers park at the gate — demand is true for longer than the
+    threshold, but the beats keep the watchdog quiet.  When the
+    straggler finally arrives the round releases, demand drops, and
+    /healthz-style health stays green throughout."""
+    app = _bsp_app()
+    FLIGHT.enable(role="test")
+    panel = WatchdogPanel(flight=FLIGHT)
+    threshold = 0.5
+    panel.add(Liveness("gate", threshold, beat_name="gate",
+                       demand=lambda: app.server.gate_waiting() > 0,
+                       flight=FLIGHT))
+    app.server.start_training_loop()
+    for w in range(4):
+        app.workers[w].on_weights(
+            app.fabric.poll(fabric_mod.WEIGHTS_TOPIC, w))
+    t0 = time.monotonic()
+    for _ in range(3):                      # one worker is asleep
+        app.server.process(app.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0))
+        assert app.server.gate_waiting() > 0   # BSP holds the round
+        assert panel.check_now() is True       # beat just landed
+        time.sleep(0.25)
+    # demand has now been true for longer than the threshold...
+    assert time.monotonic() - t0 > threshold
+    assert panel.check_now() is True           # ...but beats kept it alive
+    # straggler arrives: round releases, demand drops, still healthy
+    app.server.process(app.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0))
+    assert app.server.gate_waiting() == 0
+    assert panel.check_now() is True
+    assert all(d.trip_count == 0 for d in panel.watchdogs)
+    # the ring saw the whole round: 4 arrivals with vector clocks
+    kinds = [e["kind"] for e in FLIGHT.tail(100)]
+    assert kinds.count("gate.arrive") == 4
+    assert kinds.count("gate.release") >= 4
+
+
+def test_true_gate_stall_trips_dumps_once_and_recovers(tmp_path):
+    """A genuinely wedged gate (workers parked, no beats) trips, writes
+    ONE flight dump on the trip edge, and un-trips when the stall
+    resolves."""
+    app = _bsp_app()
+    FLIGHT.enable(role="server", flight_dir=str(tmp_path))
+    panel = WatchdogPanel(flight=FLIGHT)
+    FLIGHT.panel = panel
+    panel.add(Liveness("gate", 0.05, beat_name="gate",
+                       demand=lambda: app.server.gate_waiting() > 0,
+                       flight=FLIGHT))
+    app.server.start_training_loop()
+    for w in range(4):
+        app.workers[w].on_weights(
+            app.fabric.poll(fabric_mod.WEIGHTS_TOPIC, w))
+    for _ in range(3):
+        app.server.process(app.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0))
+    assert panel.check_now() is True        # stamps the demand window
+    time.sleep(0.15)                        # straggler never shows up
+    assert panel.check_now() is False
+    assert panel.check_now() is False       # still tripped, no new edge
+    dumps = list(tmp_path.glob("flightdump-*.json"))
+    assert len(dumps) == 1                  # one dump per trip edge
+    d = json.loads(dumps[0].read_text())
+    assert d["reason"] == "watchdog:gate"
+    assert d["watchdogs"]["gate"]["tripped"] is True
+    trips = [e for e in FLIGHT.tail(200) if e["kind"] == "watchdog.trip"]
+    assert len(trips) == 1 and trips[0]["name"] == "gate"
+    # stall resolves: the straggler's gradient beats the gate
+    app.server.process(app.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0))
+    assert panel.check_now() is True        # readiness comes back
+
+
+# -- the health plane -------------------------------------------------------
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, r.headers.get("Content-Type"), r.read()
+    except urllib.error.HTTPError as e:     # 503 is a valid probe answer
+        return e.code, e.headers.get("Content-Type"), e.read()
+
+
+def test_health_endpoints_serve_healthz_varz_flightz(tmp_path):
+    fr = FlightRecorder()
+    tel = Telemetry()
+    tel.counter("frames_sent", topic="gradients").inc(3)
+    ops = OpsPlane(flight_dir=str(tmp_path), health_port=0,
+                   telemetry=tel, role="server", shard=1, flight=fr)
+    demanded = {"v": False}
+    ops.add_watchdog("gate", 0.05, demand=lambda: demanded["v"])
+    ops.start()
+    port = ops.health.port
+    try:
+        fr.record("gate.arrive", shard=1, worker=0, clock=2, lag=0,
+                  waiting=0, clocks=[2, 2])
+        status, ctype, body = _get(port, "/healthz")
+        hz = json.loads(body)
+        assert status == 200 and ctype == "application/json"
+        assert hz["healthy"] is True
+        assert (hz["role"], hz["shard"]) == ("server", 1)
+        assert "gate" in hz["watchdogs"]
+
+        status, ctype, body = _get(port, "/varz")
+        assert status == 200 and ctype.startswith("text/plain")
+        assert b'frames_sent{topic="gradients"} 3' in body
+
+        status, _, body = _get(port, "/flightz?n=5")
+        fz = json.loads(body)
+        assert status == 200 and fz["enabled"] is True
+        assert fz["events"][-1]["kind"] == "gate.arrive"
+
+        # trip the watchdog: readiness must flip to 503
+        demanded["v"] = True
+        ops.panel.check_now()               # stamps the demand window
+        time.sleep(0.1)
+        ops.panel.check_now()
+        status, _, body = _get(port, "/healthz")
+        assert status == 503
+        assert json.loads(body)["healthy"] is False
+    finally:
+        ops.close()
+    # close wrote the final dump and disarmed the recorder
+    dumps = list(tmp_path.glob("flightdump-*.json"))
+    assert dumps, "ops.close() must write the shutdown dump"
+    reasons = {json.loads(p.read_text())["reason"] for p in dumps}
+    assert "shutdown" in reasons
+    assert fr.enabled is False
+
+
+def test_inert_ops_plane_is_safe_everywhere():
+    """No --flight-dir, no --health-port: every method is a no-op, so
+    the CLI roles wire it unconditionally."""
+    ops = OpsPlane(flight_dir=None, health_port=None, role="worker")
+    assert ops.enabled is False
+    ops.add_gate_watchdog(object())     # must not touch the dummy
+    ops.add_fsync_watchdog()
+    ops.add_replica_watchdog()
+    ops.start()
+    assert ops.health is None
+    ops.close()
+
+
+# -- dump-on-death ----------------------------------------------------------
+
+def test_sigterm_death_hook_writes_dump(tmp_path):
+    script = (
+        "import os, signal, sys, time\n"
+        "from kafka_ps_tpu.telemetry.flight import FLIGHT\n"
+        "FLIGHT.enable(role='worker', flight_dir=sys.argv[1])\n"
+        "assert FLIGHT.install_death_hooks()\n"
+        "FLIGHT.record('net.send', peer=0, bytes=128)\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(30)\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script, str(tmp_path)],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=20)
+    finally:
+        proc.kill()
+    # the handler dumped, then re-raised so the exit status still says
+    # "killed by SIGTERM" (what a supervisor expects)
+    assert proc.returncode == -signal.SIGTERM
+    dumps = list(tmp_path.glob(f"flightdump-{proc.pid}.json"))
+    assert len(dumps) == 1
+    d = json.loads(dumps[0].read_text())
+    assert d["reason"] == "signal:SIGTERM"
+    assert any(e["kind"] == "net.send" for e in d["events"])
+
+
+# -- postmortem -------------------------------------------------------------
+
+def _dump_file(tmp_path, name, **kw):
+    d = {"schema": "kps-flightdump-v1", "pid": kw.pop("pid", 1),
+         "role": kw.pop("role", "worker"), "shard": kw.pop("shard", None),
+         "meta": kw.pop("meta", {}), "reason": kw.pop("reason", ""),
+         "wallClockT0": 0.0, "dumpedAt": kw.pop("dumpedAt", 100.0),
+         "events": kw.pop("events", []), "beats": {}, "inflight": {},
+         "threads": {}, "lockEdges": [], "metrics": {},
+         "watchdogs": kw.pop("watchdogs", {})}
+    assert not kw, kw
+    (tmp_path / name).write_text(json.dumps(d))
+    return d
+
+
+def test_postmortem_names_dead_shard_and_last_ack(tmp_path, capsys):
+    """The SIGKILL story: shard 1 died without a dump.  The survivors'
+    dumps (server shard 0, one worker) must convict it and report the
+    last (worker, clock) it acknowledged."""
+    _dump_file(tmp_path, "flightdump-10.json", pid=10, role="server",
+               shard=0, reason="signal:SIGTERM",
+               meta={"shards": [0, 1]})
+    _dump_file(tmp_path, "flightdump-20.json", pid=20, role="worker",
+               meta={"shards": [0, 1]}, dumpedAt=50.0, events=[
+                   {"t": 40.0, "thread": "MainThread",
+                    "kind": "shard.weights", "shard": 1, "worker": 0,
+                    "clock": 5},
+                   {"t": 42.0, "thread": "MainThread",
+                    "kind": "shard.weights", "shard": 1, "worker": 1,
+                    "clock": 7},
+                   {"t": 43.0, "thread": "MainThread",
+                    "kind": "shard.weights", "shard": 0, "worker": 1,
+                    "clock": 7},
+               ])
+    report = postmortem.analyze(postmortem.load_dumps(str(tmp_path)))
+    assert report["knownShards"] == [0, 1]
+    assert report["deadShards"] == [1]
+    ack = report["lastAcks"][1]
+    assert (ack["worker"], ack["clock"]) == (1, 7)
+    text = postmortem.format_report(report)
+    assert "dead shard 1: no flight dump" in text
+    assert ("last ack from shard 1: weights for worker 1 at clock 7"
+            in text)
+    assert postmortem.main(str(tmp_path)) == 0
+    assert "dead shard 1" in capsys.readouterr().out
+
+
+def test_postmortem_all_shards_alive_and_empty_dir(tmp_path, capsys):
+    _dump_file(tmp_path, "flightdump-10.json", pid=10, role="server",
+               shard=0, meta={"shards": [0]})
+    assert postmortem.main(str(tmp_path)) == 0
+    assert "no dead shards" in capsys.readouterr().out
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert postmortem.main(str(empty)) == 1    # no dumps = no evidence
+
+
+def test_postmortem_surfaces_watchdog_trips(tmp_path):
+    _dump_file(tmp_path, "flightdump-30.json", pid=30, role="server",
+               shard=0, meta={"shards": [0]},
+               watchdogs={"gate": {"tripped": True, "threshold_s": 30.0,
+                                   "trip_count": 1,
+                                   "reason": "gate: no progress"}})
+    report = postmortem.analyze(postmortem.load_dumps(str(tmp_path)))
+    assert report["deadShards"] == []
+    (trip,) = report["watchdogTrips"]
+    assert trip["watchdog"] == "gate"
+    assert "watchdog trip" in postmortem.format_report(report)
+
+
+def test_postmortem_cli_module(tmp_path):
+    _dump_file(tmp_path, "flightdump-10.json", pid=10, role="server",
+               shard=0, meta={"shards": [0, 1]})
+    proc = subprocess.run(
+        [sys.executable, "-m", "kafka_ps_tpu.telemetry", "postmortem",
+         str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "dead shard 1" in proc.stdout
+
+
+# -- prometheus exposition escaping (regression) ---------------------------
+
+def test_prometheus_text_escapes_hostile_label_values():
+    """Label values that contain the exposition format's own syntax —
+    backslashes (Windows paths), quotes, newlines (a --connect list
+    pasted with a stray \\n) — must escape per the spec: backslash
+    first, then quote, then newline."""
+    reg = MetricsRegistry()
+    hostile = 'C:\\logs\n"quoted",peer'
+    reg.counter("frames_sent", peer=hostile).inc()
+    text = reg.prometheus_text()
+    expected = r'peer="C:\\logs\n\"quoted\",peer"'
+    assert expected in text
+    # no raw newline may survive inside a sample line
+    sample = [ln for ln in text.splitlines()
+              if ln.startswith("frames_sent{")]
+    assert len(sample) == 1 and sample[0].endswith(" 1")
