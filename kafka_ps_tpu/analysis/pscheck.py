@@ -89,6 +89,12 @@ HANDLER_NAMES = frozenset({
     # here is charged to every request the generator issues, skewing
     # the very latency the harness measures
     "_issue", "_drive", "settle", "make_issue",
+    # serving/shm.py + net.ServerBridge._shm_serve: the shared-memory
+    # RPC hot path — per-request on both sides of the channel
+    "rpc", "serve_once", "respond", "_shm_serve",
+    # serving/costmodel.py: fed from inside _dispatch/_serve — a sync
+    # here would bill the cost model's own bookkeeping to the request
+    "observe_dispatch", "observe_arrival", "window_s",
 })
 
 # PS102 host-sync markers

@@ -228,6 +228,23 @@ def build_parser(include_server_flags: bool = True,
                         "estimated queueing delay (EWMA batch service "
                         "time x queued batches) exceeds MS milliseconds "
                         "(0 = off)")
+    p.add_argument("--serve-auto", dest="serve_auto", action="store_true",
+                   default=True,
+                   help="adaptive dispatch (default ON): the engine "
+                        "learns per-model dispatch cost vs occupancy, "
+                        "bypasses the batching queue below the measured "
+                        "break-even, and sizes the batch window from the "
+                        "live arrival rate (docs/SERVING.md, 'Dispatch "
+                        "economics')")
+    p.add_argument("--no-serve-auto", dest="serve_auto",
+                   action="store_false",
+                   help="disable adaptive dispatch: always micro-batch "
+                        "with the full configured window (the pre-cost-"
+                        "model behaviour)")
+    p.add_argument("--serve-shm", dest="serve_shm", action="store_true",
+                   help="offer co-located PredictClients a shared-memory "
+                        "fast path (skips TCP framing); remote or legacy "
+                        "clients fall back to sockets transparently")
     return p
 
 
@@ -281,7 +298,9 @@ def make_app_from_args(args, resuming: bool = False,
             deadline_ms=getattr(args, "serve_deadline_ms", 2.0),
             ring_capacity=getattr(args, "serve_snapshots", 8),
             queue_limit=getattr(args, "serve_queue", 0),
-            shed_deadline_ms=getattr(args, "serve_shed_ms", 0.0)),
+            shed_deadline_ms=getattr(args, "serve_shed_ms", 0.0),
+            auto=getattr(args, "serve_auto", True),
+            shm=getattr(args, "serve_shm", False)),
     )
     test_x, test_y = load_test_csv(args.test_data_file_path,
                                    args.num_features)
@@ -484,7 +503,9 @@ def run_with_args(args) -> int:
             serve_bridge = net.ServerBridge(port=args.serve_port,
                                             run_id=app.server.run_id,
                                             tracer=app.tracer,
-                                            telemetry=app.telemetry)
+                                            telemetry=app.telemetry,
+                                            shm=getattr(args, "serve_shm",
+                                                        False))
             serve_bridge.attach_serving(engine)
             print(f"serving on port {serve_bridge.port}",
                   file=sys.stderr, flush=True)

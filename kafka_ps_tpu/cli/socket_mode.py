@@ -236,7 +236,8 @@ def run_server(args) -> int:
         heartbeat_timeout=hb_timeout,
         run_id=run_id,
         codec=codec_spec,
-        tracer=tracer, telemetry=telemetry)
+        tracer=tracer, telemetry=telemetry,
+        shm=getattr(args, "serve_shm", False))
     print(f"listening on port {bridge.port}", file=sys.stderr, flush=True)
     from kafka_ps_tpu.utils.asynclog import DeferredSink
     fabric = bridge.wrap(fabric_mod.Fabric())
@@ -281,9 +282,13 @@ def run_server(args) -> int:
             deadline_s=getattr(args, "serve_deadline_ms", 2.0) / 1000.0,
             queue_limit=getattr(args, "serve_queue", 0),
             shed_deadline_s=shed_ms / 1000.0 if shed_ms else None,
+            auto=getattr(args, "serve_auto", True),
             tracer=tracer, telemetry=telemetry)
         bridge.attach_serving(engine)
         server.publish_snapshot()    # cold start: restored/fresh theta
+        # compile every bucket shape + calibrate the dispatch cost
+        # model now, not in some client's p99 (docs/SERVING.md)
+        engine.warmup()
         print(f"serving predictions on port {bridge.port}",
               file=sys.stderr, flush=True)
 
@@ -1143,6 +1148,7 @@ def run_replica(args) -> int:
         deadline_s=getattr(args, "serve_deadline_ms", 2.0) / 1000.0,
         queue_limit=getattr(args, "serve_queue", 0),
         shed_deadline_s=shed_ms / 1000.0 if shed_ms else None,
+        auto=getattr(args, "serve_auto", True),
         tracer=tracer, telemetry=telemetry)
     follower.catch_up()              # cold start: serve what's logged
     ops = _make_ops(args, telemetry, role="replica")
@@ -1152,7 +1158,8 @@ def run_replica(args) -> int:
     port = getattr(args, "serve_port", None)
     bridge = net.ServerBridge(port=0 if port is None else port,
                               run_id=time.time_ns(), tracer=tracer,
-                              telemetry=telemetry)
+                              telemetry=telemetry,
+                              shm=getattr(args, "serve_shm", False))
     bridge.attach_serving(engine)
     follower.start()
     mode = (f"{follower.num_shards}-shard assembled"
@@ -1163,6 +1170,18 @@ def run_replica(args) -> int:
     if engine.warmup():
         print(f"replica warm at clock {follower.clock}",
               file=sys.stderr, flush=True)
+    else:
+        # started against an empty log: warm (compile buckets +
+        # calibrate the dispatch cost model) the moment theta appears
+        warmed = threading.Event()
+
+        def _warm_on_first_publish(clock, _e=warmed):
+            if not _e.is_set() and engine.warmup():
+                _e.set()
+                print(f"replica warm at clock {clock}",
+                      file=sys.stderr, flush=True)
+
+        follower.on_publish = _warm_on_first_publish
     try:
         # serve until killed — a replica has no natural end of run;
         # deployment manifests (deploy/k8s/replica.yaml) scale and

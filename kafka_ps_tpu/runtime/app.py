@@ -243,6 +243,7 @@ class StreamingPSApp:
             queue_limit=scfg.queue_limit,
             shed_deadline_s=(scfg.shed_deadline_ms / 1000.0
                              if scfg.shed_deadline_ms else None),
+            auto=scfg.auto,
             tracer=self.tracer, telemetry=self.telemetry)
         return self.serving_engine
 

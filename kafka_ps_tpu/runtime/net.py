@@ -108,6 +108,14 @@ _TRACE_TRAILER = struct.Struct("<B")
 # the per-message trace context suffixed to WEIGHTS/GRADIENTS payloads
 # when the pair negotiated tracing: <u64 flow_id> <u64 parent_span>
 _TRACE_CTX = struct.Struct("<QQ")
+# the optional shared-memory request byte AFTER the trace trailer on
+# HELLO, and the matching offer AFTER the trace trailer on CONFIG:
+# <u8 granted> <16s nonce> <64s NUL-padded segment name>.  Same
+# append-and-length-check pattern as the codec/trace trailers: legacy
+# peers on either side never see the bytes and stay on sockets
+# (serving/shm.py, docs/SERVING.md "Dispatch economics")
+_SHM_TRAILER = struct.Struct("<B")
+_SHM_OFFER = struct.Struct("<B16s64s")
 
 # -- serving-plane payloads (kafka_ps_tpu/serving/, docs/SERVING.md) -------
 # PREDICT: the feature row plus the request's staleness bound; sentinel
@@ -157,6 +165,22 @@ def encode_prediction(status: int, label: int = -1, confidence: float = 0.0,
 def decode_prediction(payload: bytes):
     """(status, label, confidence, vector_clock, wall_time)."""
     return _PREDICTION.unpack_from(payload, 0)
+
+
+def _encode_result(result) -> bytes:
+    """Map a PredictionEngine callback argument — a Prediction, or the
+    typed failure the engine passed instead — onto a wire PREDICTION
+    payload.  Shared by the socket reply path and the shm serve loop so
+    the two transports cannot drift on status semantics."""
+    from kafka_ps_tpu.serving.policy import OverloadedError, StalenessError
+    if isinstance(result, OverloadedError):
+        return encode_prediction(PREDICT_OVERLOADED)
+    if isinstance(result, StalenessError):
+        return encode_prediction(PREDICT_STALE)
+    if isinstance(result, BaseException):
+        return encode_prediction(PREDICT_FAILED)
+    return encode_prediction(PREDICT_OK, result.label, result.confidence,
+                             result.vector_clock, result.wall_time)
 
 
 def send_frame(sock: socket.socket, topic: int, key: int,
@@ -213,6 +237,28 @@ def _read_trace_flag(payload, offset: int) -> bool:
         return False
     (flag,) = _TRACE_TRAILER.unpack_from(payload, offset)
     return bool(flag)
+
+
+def _read_shm_flag(payload, offset: int) -> bool:
+    """The optional <u8> shared-memory request after the trace trailer
+    on HELLO; False when absent (old peer, or a client on sockets)."""
+    if len(payload) < offset + _SHM_TRAILER.size:
+        return False
+    (flag,) = _SHM_TRAILER.unpack_from(payload, offset)
+    return bool(flag)
+
+
+def _read_shm_offer(payload, offset: int) -> tuple[str, bytes] | None:
+    """The optional shm offer after the trace trailer on CONFIG:
+    (segment name, nonce), or None when absent (legacy server) or the
+    server declined (granted byte 0 — shm off, or segment creation
+    failed on its side)."""
+    if len(payload) < offset + _SHM_OFFER.size:
+        return None
+    granted, nonce, name = _SHM_OFFER.unpack_from(payload, offset)
+    if not granted:
+        return None
+    return name.rstrip(b"\0").decode("ascii", "replace"), nonce
 
 
 def _frame_counters(telemetry):
@@ -287,7 +333,7 @@ class ServerBridge:
                  heartbeat_interval: float | None = None,
                  heartbeat_timeout: float | None = None,
                  run_id: int = 0, codec: CodecSpec | None = None,
-                 tracer=None, telemetry=None):
+                 tracer=None, telemetry=None, shm: bool = False):
         # `run_id` identifies the logical RUN (fresh server start, or
         # the run a checkpoint resume continues — utils/checkpoint.py
         # persists it).  Advertised in T_CONFIG so worker processes can
@@ -325,6 +371,14 @@ class ServerBridge:
         self.on_hello = None        # Callable[[list[int]], None]
         self.on_ready = None        # Callable[[int], None]
         self._serving = None        # PredictionEngine (attach_serving)
+        # same-host shared-memory fast path (serving/shm.py): offered
+        # per connection on a HELLO that requests it, only when enabled
+        # here AND a serving engine is attached
+        self._shm_enabled = bool(shm)
+        self._shm_of: dict[socket.socket, object] = {}
+        self._shm_threads: list[threading.Thread] = []
+        self._m_shm = self._telemetry.counter("serving_dispatch_mode",
+                                              mode="shm")
         self.dropped_sends = 0      # frames lost to dead connections
         self._hb_interval = heartbeat_interval
         self._hb_timeout = heartbeat_timeout
@@ -450,6 +504,14 @@ class ServerBridge:
         # every live connection, including ones that never sent HELLO
         for conn in list(self._send_lock):
             force_close(conn)        # wakes the blocked reader thread
+        # shm channels whose reader cleanup has not run yet: close (and
+        # unlink — this side owns the segments) so no serve thread spins
+        # on an unlinked mapping and /dev/shm is left clean
+        for chan in list(self._shm_of.values()):
+            chan.close()
+        for t in list(self._shm_threads):
+            if t is not threading.current_thread():
+                t.join(timeout=10.0)
         # join everything before returning: readers hand GRADIENTS into
         # the fabric (device arrays) and the heartbeat waits at most one
         # interval — a thread left alive at interpreter exit can die
@@ -580,6 +642,20 @@ class ServerBridge:
                         payload, 8 + 8 * n + _CODEC_TRAILER.size)
                         and self._tracer.enabled)
                     self._trace_of[conn] = trace_on
+                    # shm negotiation: the offer rides CONFIG only when
+                    # the peer asked — worker handshakes stay
+                    # byte-identical to every earlier version
+                    shm_tail = b""
+                    if _read_shm_flag(payload, 8 + 8 * n
+                                      + _CODEC_TRAILER.size
+                                      + _TRACE_TRAILER.size):
+                        chan = self._offer_shm(conn)
+                        shm_tail = (_SHM_OFFER.pack(0, b"", b"")
+                                    if chan is None else
+                                    _SHM_OFFER.pack(
+                                        1, chan.nonce,
+                                        # pscheck: disable=PS103 (segment name is a fresh control string, not message parts)
+                                        chan.name.encode("ascii")))
                     # T_CONFIG goes out BEFORE the ids are registered:
                     # once registered, the producer thread may race data
                     # rows onto this connection, and the worker-side
@@ -596,7 +672,8 @@ class ServerBridge:
                                    + _CODEC_TRAILER.pack(
                                        negotiated.codec_id,
                                        negotiated.param)
-                                   + _TRACE_TRAILER.pack(int(trace_on)))
+                                   + _TRACE_TRAILER.pack(int(trace_on))
+                                   + shm_tail)
                     with self._cv:
                         for w in ids:
                             self._conn_of[w] = conn
@@ -652,8 +729,7 @@ class ServerBridge:
             self._send_raw(conn, T_PREDICTION, key,
                            encode_prediction(PREDICT_FAILED))
             return
-        from kafka_ps_tpu.serving.policy import (OverloadedError, ReadBound,
-                                                 StalenessError)
+        from kafka_ps_tpu.serving.policy import OverloadedError, ReadBound
         try:
             x, min_clock, max_age_s, model_id = \
                 decode_predict_request(payload)
@@ -664,18 +740,7 @@ class ServerBridge:
             return
 
         def reply(result, conn=conn, key=key):
-            if isinstance(result, OverloadedError):
-                pl = encode_prediction(PREDICT_OVERLOADED)
-            elif isinstance(result, StalenessError):
-                pl = encode_prediction(PREDICT_STALE)
-            elif isinstance(result, BaseException):
-                pl = encode_prediction(PREDICT_FAILED)
-            else:
-                pl = encode_prediction(PREDICT_OK, result.label,
-                                       result.confidence,
-                                       result.vector_clock,
-                                       result.wall_time)
-            self._send_raw(conn, T_PREDICTION, key, pl)
+            self._send_raw(conn, T_PREDICTION, key, _encode_result(result))
 
         try:
             engine.submit(x, bound, reply, model_id=model_id)
@@ -689,6 +754,61 @@ class ServerBridge:
             # unknown model id, or engine already closed (shutdown race)
             self._send_raw(conn, T_PREDICTION, key,
                            encode_prediction(PREDICT_FAILED))
+
+    def _offer_shm(self, conn):
+        """Create a per-connection shm channel plus its serve thread;
+        None (a declined offer, the client stays on sockets) when shm is
+        disabled here, no serving engine is attached, or the segment
+        cannot be created (e.g. /dev/shm exhausted)."""
+        if not self._shm_enabled or self._serving is None:
+            return None
+        try:
+            from kafka_ps_tpu.serving.shm import ShmChannel
+            chan = ShmChannel.create()
+        except Exception:  # noqa: BLE001 — degrade, never fail the HELLO
+            return None
+        t = threading.Thread(target=self._shm_serve, args=(chan,),
+                             daemon=True, name="kps-shm-serve")
+        with self._cv:
+            self._shm_of[conn] = chan
+            self._shm_threads.append(t)
+        t.start()
+        return chan
+
+    def _shm_serve(self, chan) -> None:
+        """Per-channel poll loop: pop the pending request, submit it to
+        the engine async (same as the socket path — this thread never
+        blocks on a batch window), publish the reply from the engine's
+        callback.  Depth-1 protocol, so an unanswered seq backpressures
+        exactly one client."""
+        from kafka_ps_tpu.serving.policy import OverloadedError, ReadBound
+        engine = self._serving
+        while not self._stop.is_set() and not chan.closed:
+            got = chan.serve_once()
+            if got is None:
+                time.sleep(0.0002)
+                continue
+            seq, raw = got
+            try:
+                x, min_clock, max_age_s, model_id = \
+                    decode_predict_request(raw)
+                bound = ReadBound(min_clock=min_clock, max_age_s=max_age_s)
+            except Exception:  # noqa: BLE001 — malformed payload
+                chan.respond(seq, encode_prediction(PREDICT_FAILED))
+                continue
+
+            def reply(result, seq=seq):
+                chan.respond(seq, _encode_result(result))
+                self._m_shm.inc()
+                if FLIGHT.enabled:
+                    FLIGHT.record("serving.batch", n=1, mode="shm")
+
+            try:
+                engine.submit(x, bound, reply, model_id=model_id)
+            except OverloadedError:
+                reply(OverloadedError("shed"))
+            except (ValueError, RuntimeError) as err:
+                reply(err)
 
     def _cleanup_conn(self, conn: socket.socket) -> None:
         """Purge a dead connection's registrations and surface the
@@ -707,7 +827,10 @@ class ServerBridge:
             self._last_recv.pop(conn, None)
             self._codec_of.pop(conn, None)
             self._trace_of.pop(conn, None)
+            chan = self._shm_of.pop(conn, None)
             self._cv.notify_all()
+        if chan is not None:
+            chan.close()    # wakes + ends the kps-shm-serve thread
         if FLIGHT.enabled and ids:
             FLIGHT.record("net.disconnect", workers=ids)
         if ids and not self._stop.is_set() and self.on_disconnect is not None:
@@ -989,7 +1112,7 @@ class PredictClient:
 
     def __init__(self, host: str, port: int, timeout: float = 30.0, *,
                  reconnect: bool = False, reconnect_timeout: float = 10.0,
-                 model_id: int = 0):
+                 model_id: int = 0, shm: bool = False):
         self._host, self._port = host, port
         self._timeout = timeout
         self._reconnect = reconnect
@@ -999,7 +1122,11 @@ class PredictClient:
         self._req = 0
         self._closed = False
         self.reconnects = 0          # successful re-dials (ops/test surface)
+        self._shm = bool(shm)
+        self._chan = None            # ShmChannel once negotiated
         self._sock = self._dial()
+        if self._shm:
+            self._chan = self._negotiate_shm()
 
     def _dial(self) -> socket.socket:
         sock = socket.create_connection((self._host, self._port),
@@ -1007,6 +1134,52 @@ class PredictClient:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(self._timeout)
         return sock
+
+    def _negotiate_shm(self):
+        """Ask the server for a shared-memory channel: an empty-ids
+        HELLO carrying the shm request trailer, answered by a CONFIG
+        whose offer names the segment (docs/SERVING.md, "Dispatch
+        economics").  ANY failure — legacy server (no offer bytes),
+        declined offer, remote peer (the segment name does not exist on
+        this host), nonce mismatch — returns None and the client stays
+        on the socket it already holds.  Registering zero worker ids
+        keeps this connection invisible to the weights/data routing,
+        exactly like a plain predict-only connection."""
+        try:
+            locked_send(self._sock, self._send_lock, T_HELLO, 0,
+                        struct.pack("<q", 0)
+                        + _CODEC_TRAILER.pack(CODEC_SPEC_NONE.codec_id,
+                                              CODEC_SPEC_NONE.param)
+                        + _TRACE_TRAILER.pack(0)
+                        + _SHM_TRAILER.pack(1))
+            while True:
+                frame = recv_frame(self._sock)
+                if frame is None:
+                    return None
+                topic, _key, payload = frame
+                if topic == T_PING:
+                    locked_send(self._sock, self._send_lock, T_PONG, 0)
+                    continue
+                if topic != T_CONFIG:
+                    continue
+                offer = _read_shm_offer(
+                    payload,
+                    16 + _CODEC_TRAILER.size + _TRACE_TRAILER.size)
+                if offer is None:
+                    return None
+                name, nonce = offer
+                from kafka_ps_tpu.serving.shm import ShmChannel
+                return ShmChannel.attach(name, nonce)
+        except Exception:  # noqa: BLE001 — every failure means sockets
+            return None
+
+    def _drop_chan(self) -> None:
+        chan, self._chan = self._chan, None
+        if chan is not None:
+            try:
+                chan.close()
+            except Exception:  # noqa: BLE001 — already torn down
+                pass
 
     def _redial(self) -> None:
         """Replace the dead socket, backing off exponentially (0.05 s
@@ -1021,6 +1194,12 @@ class PredictClient:
             try:
                 self._sock = self._dial()
                 self.reconnects += 1
+                if self._shm:
+                    # the old segment died with the old server process;
+                    # negotiate a fresh channel (or fall back) before
+                    # the replayed request goes out
+                    self._drop_chan()
+                    self._chan = self._negotiate_shm()
                 return
             except OSError as err:
                 if time.monotonic() + backoff > deadline:
@@ -1043,6 +1222,17 @@ class PredictClient:
         payload = encode_predict_request(
             x, min_clock, max_age_s,
             self._model_id if model_id is None else model_id)
+        chan = self._chan
+        if chan is not None:
+            try:
+                raw = chan.rpc(bytes(payload), timeout=self._timeout)
+            except Exception:  # noqa: BLE001 — transport died mid-flight:
+                # drop the channel and fall through to the socket below
+                # (transparent degradation; OVERLOADED/STALE are healthy
+                # REPLIES and raise from _decode_reply, not here)
+                self._drop_chan()
+            else:
+                return self._decode_reply(raw, min_clock, max_age_s)
         while True:
             try:
                 locked_send(self._sock, self._send_lock, T_PREDICT,
@@ -1067,22 +1257,34 @@ class PredictClient:
                 continue
             if topic != T_PREDICTION or key != self._req:
                 continue            # stray control frame (e.g. CONFIG)
-            status, label, conf, clock, wall = decode_prediction(payload)
-            if status == PREDICT_STALE:
-                from kafka_ps_tpu.serving.policy import StalenessError
-                raise StalenessError(
-                    f"server rejected the read bound (min_clock="
-                    f"{min_clock}, max_age_s={max_age_s})",
-                    min_clock=min_clock, max_age_s=max_age_s)
-            if status == PREDICT_OVERLOADED:
-                from kafka_ps_tpu.serving.policy import OverloadedError
-                raise OverloadedError(
-                    "server shed the request (admission queue full)")
-            if status != PREDICT_OK:
-                raise RuntimeError("prediction failed on the server")
-            from kafka_ps_tpu.serving.engine import Prediction
-            return Prediction(label, conf, clock, wall)
+            return self._decode_reply(payload, min_clock, max_age_s)
+
+    def _decode_reply(self, payload, min_clock, max_age_s):
+        """One PREDICTION payload (socket frame or shm response buffer)
+        to the caller's result: Prediction, or the typed error."""
+        status, label, conf, clock, wall = decode_prediction(payload)
+        if status == PREDICT_STALE:
+            from kafka_ps_tpu.serving.policy import StalenessError
+            raise StalenessError(
+                f"server rejected the read bound (min_clock="
+                f"{min_clock}, max_age_s={max_age_s})",
+                min_clock=min_clock, max_age_s=max_age_s)
+        if status == PREDICT_OVERLOADED:
+            from kafka_ps_tpu.serving.policy import OverloadedError
+            raise OverloadedError(
+                "server shed the request (admission queue full)")
+        if status != PREDICT_OK:
+            raise RuntimeError("prediction failed on the server")
+        from kafka_ps_tpu.serving.engine import Prediction
+        return Prediction(label, conf, clock, wall)
+
+    @property
+    def shm_active(self) -> bool:
+        """True while predict() rides the shared-memory channel
+        (ops/test surface — flips False on fallback)."""
+        return self._chan is not None
 
     def close(self) -> None:
         self._closed = True
+        self._drop_chan()
         force_close(self._sock)

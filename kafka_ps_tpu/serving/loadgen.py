@@ -111,12 +111,14 @@ class SocketTarget:
     def __init__(self, host: str, port: int, *,
                  min_clock: int | None = None,
                  max_age_s: float | None = None, model_id: int = 0,
-                 reconnect: bool = False, timeout: float = 30.0):
+                 reconnect: bool = False, timeout: float = 30.0,
+                 shm: bool = False):
         self.host, self.port = host, port
         self.min_clock, self.max_age_s = min_clock, max_age_s
         self.model_id = model_id
         self.reconnect = reconnect
         self.timeout = timeout
+        self.shm = shm          # per-client shared-memory negotiation
         self._clients: list = []
         self._lock = OrderedLock("loadgen.SocketTarget.clients")
 
@@ -125,7 +127,8 @@ class SocketTarget:
         client = net.PredictClient(self.host, self.port,
                                    timeout=self.timeout,
                                    reconnect=self.reconnect,
-                                   model_id=self.model_id)
+                                   model_id=self.model_id,
+                                   shm=self.shm)
         with self._lock:
             self._clients.append(client)
         min_clock, max_age_s = self.min_clock, self.max_age_s

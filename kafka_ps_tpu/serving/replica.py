@@ -92,6 +92,13 @@ class ReplicaFollower:
             self._cut = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Callable[[int], None], fired (from the calling thread — the
+        # tail thread once start()ed) with the new clock after every
+        # publish.  run_replica uses it to warm the serving engine the
+        # moment a replica that started against an EMPTY log first sees
+        # theta: warmup is a no-op without a snapshot, and an unwarmed
+        # engine never calibrates its dispatch cost model.
+        self.on_publish = None
 
     # -- synchronous follow ---------------------------------------------------
 
@@ -136,6 +143,10 @@ class ReplicaFollower:
                 FLIGHT.record("replica.publish",
                               clock=(latest.vector_clock
                                      if latest is not None else -1))
+            if self.on_publish is not None:
+                latest = self.registry.latest
+                self.on_publish(latest.vector_clock
+                                if latest is not None else -1)
         return published
 
     @property

@@ -76,6 +76,8 @@ class ServingConfig:
     ring_capacity: int = 8        # retained snapshots (at_clock reads)
     queue_limit: int = 0          # per-tenant admission budget; 0 = none
     shed_deadline_ms: float = 0.0  # predictive shed threshold; 0 = off
+    auto: bool = True             # adaptive dispatch (costmodel.py)
+    shm: bool = False             # offer same-host shared-memory path
 
 
 @dataclasses.dataclass(frozen=True)

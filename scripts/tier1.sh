@@ -40,7 +40,10 @@
 # flash crowd, an offered-rate Poisson arm (open loop, latency from
 # scheduled arrival) answering within the smoke SLO with zero errors,
 # and the trained theta bitwise-identical to a no-load run
-# (docs/SERVING.md, "Operating at load").
+# (docs/SERVING.md, "Operating at load").  A final in-process arm
+# proves the adaptive dispatcher settles on the batching BYPASS at
+# low concurrency with p99 no worse than a hand-tuned unbatched
+# engine (docs/SERVING.md, "Dispatch economics").
 #
 # `scripts/tier1.sh --analyze` runs the static-analysis leg: pscheck
 # (docs/ANALYSIS.md) over the package — fails on ANY unsuppressed
@@ -190,10 +193,55 @@ ts = np.asarray(zs["theta"], np.float32)
 tq = np.asarray(zq["theta"], np.float32)
 assert ts.tobytes() == tq.tobytes(), \
     "read load perturbed training theta"
+
+# -- adaptive-dispatch arm (ROADMAP item 4): at low concurrency the
+# auto engine must SETTLE ON THE BYPASS PATH — no queue, no window
+# wait — and its accepted p99 must be no worse than a hand-tuned
+# unbatched engine (max_batch=1, deadline 0), modulo scheduler noise.
+# Runs after both training children exit so the box is quiet.
+from kafka_ps_tpu.models.task import get_task
+from kafka_ps_tpu.serving.engine import PredictionEngine
+from kafka_ps_tpu.serving.snapshot import SnapshotRegistry
+from kafka_ps_tpu.utils.config import ModelConfig
+
+def _engine(**kw):
+    cfg = ModelConfig(num_features=8, num_classes=2)
+    task = get_task("logreg", cfg)
+    reg = SnapshotRegistry()
+    reg.publish(np.full(task.num_params, 0.5, np.float32), vector_clock=1)
+    eng = PredictionEngine(task, reg, **kw)
+    eng.warmup()
+    return eng
+
+auto_eng = _engine()                               # adaptive (default)
+plain_eng = _engine(max_batch=1, deadline_s=0.0)   # hand-tuned unbatched
+try:
+    auto_res = loadgen.run_closed_loop(loadgen.EngineTarget(auto_eng), 8,
+                                       concurrency=1, duration_s=2.0)
+    auto_stats = auto_eng.stats()
+    plain_res = loadgen.run_closed_loop(loadgen.EngineTarget(plain_eng), 8,
+                                        concurrency=1, duration_s=2.0)
+finally:
+    auto_eng.close()
+    plain_eng.close()
+assert auto_stats["mode"] == "bypass", \
+    f"auto engine never settled on bypass at conc 1: {auto_stats}"
+assert auto_stats["bypasses"] > 0, auto_stats
+assert auto_res.errors == auto_res.shed == 0, auto_res.as_dict()
+# the whole point of adaptive dispatch: an idle-occupancy caller must
+# not pay the micro-batching tax.  Same box, same inline path length —
+# 1.5x multiplicative + 0.3 ms additive slack absorbs scheduler noise.
+assert auto_res.p99_ms <= 1.5 * plain_res.p99_ms + 0.3, (
+    f"bypass p99 {auto_res.p99_ms:.3f} ms worse than unbatched "
+    f"{plain_res.p99_ms:.3f} ms")
+
 print(f"LOAD_SMOKE_OK low_p99_ms={low.p99_ms} low_ok={low.ok} "
       f"sheds={over.shed} shed_rate={over.shed_rate:.3f} "
       f"poisson_p99_ms={pois.p99_ms} poisson_ok={pois.ok} "
       f"poisson_shed={pois.shed} "
+      f"bypass_p99_ms={auto_res.p99_ms:.3f} "
+      f"unbatched_p99_ms={plain_res.p99_ms:.3f} "
+      f"dispatch_mode={auto_stats['mode']} "
       f"theta=bitwise-identical iters={MAX_IT}")
 EOF
     exit $?

@@ -1,0 +1,11 @@
+"""PS102 positive fixture (costmodel scope): the dispatch cost model's
+sample intake host-syncs the device latency scalar — the bookkeeping
+that is supposed to be free gets billed to every dispatch it observes."""
+
+
+class CostModel:
+    def __init__(self):
+        self.t = 0.0
+
+    def observe_dispatch(self, rows, bucket, dt_dev):
+        self.t = 0.8 * self.t + 0.2 * dt_dev.item()

@@ -1,0 +1,11 @@
+"""PS102 negative fixture (costmodel scope): the engine hands the cost
+model a host float it already owns (a monotonic-clock delta) — the
+intake is pure host arithmetic, no device value in sight."""
+
+
+class CostModel:
+    def __init__(self):
+        self.t = 0.0
+
+    def observe_dispatch(self, rows, bucket, dt_s):
+        self.t = 0.8 * self.t + 0.2 * dt_s

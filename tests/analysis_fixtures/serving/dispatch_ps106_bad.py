@@ -1,0 +1,7 @@
+"""PS106 positive fixture (serving dispatch scope): a serving.batch
+flight event whose field fetches a device value inside the recording
+arguments — the dispatch-mode observability stalls the dispatch."""
+
+
+def publish_dispatch_event(flight, mode, occ_dev):
+    flight.record("serving.batch", mode=mode, occupancy=float(occ_dev))

@@ -1,0 +1,10 @@
+"""PS106 negative fixture (serving dispatch scope): the serving.batch
+event carries host scalars the dispatch path already owns — the cost
+model's EWMAs are plain floats, never device values."""
+
+
+def publish_dispatch_event(flight, counter, mode, occupancy, break_even):
+    counter.inc()
+    flight.record("serving.batch", mode=mode,
+                  occupancy=round(occupancy, 2),
+                  break_even=round(break_even, 2))

@@ -1,0 +1,14 @@
+"""PS105 positive fixture (shm scope): the channel's reply poll sleeps
+while still holding the slot lock of a channel SHARED across clients —
+every other thread's rpc stalls behind one caller's wait."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def rpc(buf, payload):
+    with _lock:
+        buf.write(payload)
+        time.sleep(0.0002)

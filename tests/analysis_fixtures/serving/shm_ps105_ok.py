@@ -1,0 +1,16 @@
+"""PS105 negative fixture (shm scope): the lock covers only the slot
+claim; the bounded poll-sleep happens outside the critical section."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+_slot = [0]
+
+
+def rpc(buf, payload):
+    with _lock:
+        seq = _slot[0] = _slot[0] + 1
+        buf.write(payload)
+    time.sleep(0.0002)
+    return seq

@@ -34,6 +34,9 @@ def _findings(relpath: str):
     ("ps105_bad.py", "PS105"),
     ("serving/ps102_bad.py", "PS102"),
     ("serving/ps105_bad.py", "PS105"),
+    ("serving/costmodel_ps102_bad.py", "PS102"),
+    ("serving/shm_ps105_bad.py", "PS105"),
+    ("serving/dispatch_ps106_bad.py", "PS106"),
     ("runtime/ps106_bad.py", "PS106"),
     ("runtime/ps106_flight_bad.py", "PS106"),
 ])
@@ -53,6 +56,9 @@ def test_positive_fixture_triggers_exactly_once(relpath, rule):
     "ps105_ok.py",
     "serving/ps102_ok.py",
     "serving/ps105_ok.py",
+    "serving/costmodel_ps102_ok.py",
+    "serving/shm_ps105_ok.py",
+    "serving/dispatch_ps106_ok.py",
     "runtime/ps106_ok.py",
     "runtime/ps106_flight_ok.py",
 ])

@@ -446,3 +446,43 @@ def test_prometheus_text_escapes_hostile_label_values():
     sample = [ln for ln in text.splitlines()
               if ln.startswith("frames_sent{")]
     assert len(sample) == 1 and sample[0].endswith(" 1")
+
+
+def test_serving_batch_events_carry_dispatch_economics():
+    """Every serving.batch flight event names the chosen dispatch mode
+    and the cost model's live occupancy/break-even — the postmortem
+    evidence for 'why was this request (not) batched'."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kafka_ps_tpu.models.task import get_task
+    from kafka_ps_tpu.serving.engine import PredictionEngine
+    from kafka_ps_tpu.serving.snapshot import SnapshotRegistry
+    from kafka_ps_tpu.utils.config import ModelConfig
+
+    cfg = ModelConfig(num_features=4, num_classes=2)
+    task = get_task("logreg", cfg)
+    theta = jnp.asarray(np.random.default_rng(3)
+                        .normal(size=task.num_params).astype(np.float32))
+    registry = SnapshotRegistry()
+    registry.publish(theta, vector_clock=1)
+    engine = PredictionEngine(task, registry)
+    x = np.zeros(cfg.num_features, np.float32)
+    FLIGHT.enable(role="test")
+    try:
+        engine.warmup()                   # calibrated: singles bypass
+        for _ in range(3):
+            engine.predict(x)
+        engine._tenants[0].cost.demand = 1e9   # force the queued path
+        engine.predict(x)
+    finally:
+        engine.close()
+        events = [e for e in FLIGHT.tail(500) if e["kind"] == "serving.batch"]
+        FLIGHT.disable()
+    modes = [e["mode"] for e in events]
+    assert modes.count("bypass") == 3
+    assert modes.count("batch") == 1
+    for e in events:
+        assert e["n"] >= 1
+        assert e["occupancy"] >= 1.0
+        assert e["break_even"] >= 1.0

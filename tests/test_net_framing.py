@@ -584,3 +584,127 @@ def test_config_frame_disables_timeout_when_server_never_pings():
     time.sleep(0.5)                     # >> the 0.2 s flag
     assert not worker.disconnected.is_set()
     worker.close(), bridge.close()
+
+
+# -- same-host shared-memory fast path (serving/shm.py, negotiated on
+# HELLO/CONFIG like the codec/trace trailers) --------------------------------
+
+
+def test_shm_fast_path_end_to_end_and_legacy_client():
+    """A co-located shm=True client negotiates the channel and serves
+    predictions through it (statuses included); a legacy socket client
+    against the SAME shm-enabled bridge is untouched."""
+    from kafka_ps_tpu.serving import StalenessError
+    from kafka_ps_tpu.telemetry import Telemetry
+
+    engine, cfg = _serving_engine()
+    telemetry = Telemetry()
+    bridge = net.ServerBridge(telemetry=telemetry, shm=True)
+    bridge.attach_serving(engine)
+    fast = net.PredictClient("127.0.0.1", bridge.port, shm=True)
+    plain = net.PredictClient("127.0.0.1", bridge.port)
+    try:
+        x = np.ones(cfg.num_features, np.float32)
+        local = engine.predict(x)
+        assert fast.shm_active
+        got = fast.predict(x)
+        assert got.label == local.label
+        assert got.vector_clock == 9
+        # a healthy typed rejection rides the channel, not the socket
+        with pytest.raises(StalenessError):
+            fast.predict(x, min_clock=10)
+        assert fast.shm_active
+        for _ in range(10):
+            fast.predict(x)
+        # the legacy client negotiated nothing and still serves
+        assert not plain.shm_active
+        assert plain.predict(x).vector_clock == 9
+        snap = telemetry.snapshot()
+        assert snap["serving_dispatch_mode"]["mode=shm"] == 12
+    finally:
+        fast.close()
+        plain.close()
+        bridge.close()
+        engine.close()
+
+
+def test_shm_falls_back_when_server_declines():
+    """shm=True client against a legacy / shm-disabled server: the
+    CONFIG carries no usable offer and the client stays on sockets."""
+    engine, cfg = _serving_engine()
+    bridge = net.ServerBridge()         # shm never offered
+    bridge.attach_serving(engine)
+    client = net.PredictClient("127.0.0.1", bridge.port, shm=True)
+    try:
+        assert not client.shm_active
+        x = np.ones(cfg.num_features, np.float32)
+        assert client.predict(x).vector_clock == 9
+    finally:
+        client.close()
+        bridge.close()
+        engine.close()
+
+
+def test_shm_falls_back_when_attach_fails(monkeypatch):
+    """The remote-peer case: the offered segment name does not exist on
+    the client's host, attach raises, the client stays on sockets —
+    transparently."""
+    from kafka_ps_tpu.serving import shm as shm_mod
+
+    engine, cfg = _serving_engine()
+    bridge = net.ServerBridge(shm=True)
+    bridge.attach_serving(engine)
+
+    def remote_attach(name, nonce):
+        raise FileNotFoundError(f"no segment {name} on this host")
+
+    monkeypatch.setattr(shm_mod.ShmChannel, "attach",
+                        staticmethod(remote_attach))
+    client = net.PredictClient("127.0.0.1", bridge.port, shm=True)
+    try:
+        assert not client.shm_active
+        x = np.ones(cfg.num_features, np.float32)
+        assert client.predict(x).vector_clock == 9
+    finally:
+        client.close()
+        bridge.close()
+        engine.close()
+
+
+def test_shm_falls_back_mid_flight():
+    """Channel death between requests (server torn down the segment):
+    the in-flight rpc fails, the client degrades to its still-open
+    socket and the caller never sees the transport swap."""
+    engine, cfg = _serving_engine()
+    bridge = net.ServerBridge(shm=True)
+    bridge.attach_serving(engine)
+    client = net.PredictClient("127.0.0.1", bridge.port, shm=True)
+    try:
+        x = np.ones(cfg.num_features, np.float32)
+        assert client.shm_active
+        assert client.predict(x).vector_clock == 9
+        client._chan.mark_closed()      # simulate server-side teardown
+        assert client.predict(x).vector_clock == 9   # served via socket
+        assert not client.shm_active
+        assert client.predict(x).vector_clock == 9   # and stays there
+    finally:
+        client.close()
+        bridge.close()
+        engine.close()
+
+
+def test_shm_channel_rejects_foreign_and_oversized():
+    """Channel-level guards: nonce mismatch is a typed ShmError (name
+    collision protection), oversized payloads refuse before writing."""
+    from kafka_ps_tpu.serving.shm import DEFAULT_CAPACITY, ShmChannel, ShmError
+
+    chan = ShmChannel.create()
+    try:
+        with pytest.raises(ShmError, match="nonce"):
+            ShmChannel.attach(chan.name, b"\x00" * 16)
+        with pytest.raises(ShmError, match="capacity"):
+            chan.rpc(b"x" * (DEFAULT_CAPACITY + 1))
+        with pytest.raises(FileNotFoundError):
+            ShmChannel.attach("kps-shm-no-such-segment", b"\x00" * 16)
+    finally:
+        chan.close()

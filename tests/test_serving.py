@@ -16,6 +16,7 @@ Three contracts under test:
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -318,3 +319,186 @@ def test_threaded_runtime_serves_while_training():
         app.close_serving()
     assert not errors, errors
     assert seen and seen[-1] > 0
+
+
+# -- adaptive dispatch (serving/costmodel.py, docs/SERVING.md "Dispatch
+# economics"): bucketed shapes, the online cost model, and the bypass
+# fast path ------------------------------------------------------------------
+
+
+def _light_engine(max_batch=16, **kw):
+    """A served logreg tenant without the full app: fast enough that
+    the dispatch-economics tests can afford a real warmup."""
+    import jax.numpy as jnp
+
+    from kafka_ps_tpu.models.task import get_task
+    from kafka_ps_tpu.serving.engine import PredictionEngine
+
+    cfg = ModelConfig(num_features=6, num_classes=2)
+    task = get_task("logreg", cfg)
+    theta = jnp.asarray(np.random.default_rng(7)
+                        .normal(size=task.num_params).astype(np.float32))
+    registry = SnapshotRegistry()
+    registry.publish(theta, vector_clock=3)
+    return PredictionEngine(task, registry, max_batch=max_batch, **kw), cfg
+
+
+def test_trace_counts_one_compile_per_bucket():
+    """The TRACE_COUNTS regression surface: across a randomized live
+    batch-size sequence the engine compiles at most once per (model
+    family, batch bucket) — never per live batch size."""
+    from kafka_ps_tpu.serving import engine as engine_mod
+    from kafka_ps_tpu.serving.engine import _Request, _bucket
+
+    eng, cfg = _light_engine(max_batch=16)
+    try:
+        rng = np.random.default_rng(11)
+        sizes = [int(rng.integers(1, 17)) for _ in range(40)]
+        row = np.zeros(cfg.num_features, np.float32)
+
+        def serve(n):
+            reqs = [_Request(row, None, lambda r: None,
+                             time.monotonic(), 0)
+                    for _ in range(n)]
+            with eng._admission:     # pre-admit, as submit would
+                eng._tenants[0].depth += n
+                eng._depth += n
+            eng._serve(reqs)
+
+        before = engine_mod.TRACE_COUNTS["compiles"]
+        for n in sizes:
+            serve(n)
+        compiled = engine_mod.TRACE_COUNTS["compiles"] - before
+        assert compiled == len({_bucket(n, 16) for n in sizes})
+
+        # replaying the same size distribution compiles nothing new
+        before = engine_mod.TRACE_COUNTS["compiles"]
+        for n in sizes:
+            serve(n)
+        assert engine_mod.TRACE_COUNTS["compiles"] == before
+    finally:
+        eng.close()
+
+
+def test_warmup_precompiles_every_bucket():
+    """A warmed engine owns every bucket shape up front: live traffic
+    of ANY batch size adds zero compiles, and the cost model comes out
+    calibrated (both ends of the batch-latency curve measured)."""
+    from kafka_ps_tpu.serving import engine as engine_mod
+
+    eng, cfg = _light_engine(max_batch=16)
+    try:
+        shapes = eng.warmup()
+        assert shapes == 5               # 1, 2, 4, 8, 16
+        assert eng._tenants[0].cost.calibrated
+        before = engine_mod.TRACE_COUNTS["compiles"]
+        for _ in range(10):
+            eng.predict(np.ones(cfg.num_features, np.float32))
+        assert engine_mod.TRACE_COUNTS["compiles"] == before
+    finally:
+        eng.close()
+
+
+def test_cost_model_break_even_demand_and_window():
+    from kafka_ps_tpu.serving.costmodel import DispatchCostModel
+
+    cm = DispatchCostModel(8)
+    # uncalibrated: no bypass, full configured window (the status quo)
+    assert not cm.calibrated and not cm.bypass()
+    assert cm.window_s(1, 0.002) == 0.002
+
+    cm.seed(1, 0.001)
+    cm.seed(8, 0.004)
+    assert cm.calibrated
+    assert cm.break_even == pytest.approx(4.0)
+    assert cm.bypass()                   # demand starts at 1.0
+    assert cm.window_s(1, 0.002) == 0.0  # bypass regime: never wait
+
+    # sustained queued-path occupancy pushes demand past break-even
+    for _ in range(60):
+        cm.observe_dispatch(8, 8, 0.004)
+    assert cm.demand > cm.break_even + cm.BYPASS_SLACK
+    assert not cm.bypass()
+
+    # bypass serves are always 1 row: they must not poison the demand
+    # signal (or the engine could never re-engage batching)
+    demand = cm.demand
+    for _ in range(60):
+        cm.observe_dispatch(1, 1, 0.001, batched=False)
+    assert cm.demand == demand
+    assert cm.occupancy < demand         # reporting EWMA does follow
+
+    # the batch window is sized by the live arrival rate, capped at
+    # the configured deadline
+    cm2 = DispatchCostModel(8)
+    t = 100.0
+    for _ in range(30):
+        cm2.observe_arrival(t)
+        t += 0.0001
+    cm2.seed(1, 0.001)
+    cm2.seed(8, 0.004)
+    for _ in range(60):
+        cm2.observe_dispatch(8, 8, 0.004)      # batch regime
+    assert cm2.window_s(1, 0.002) == pytest.approx(7 * 0.0001)
+    assert cm2.window_s(1, 0.0003) == 0.0003   # deadline caps it
+    assert cm2.arrival_qps == pytest.approx(10000.0, rel=0.01)
+
+
+def test_auto_dispatch_bypasses_then_rebatches():
+    """The self-correcting mode loop: a lone closed-loop client settles
+    on the bypass fast path; sustained concurrency re-engages batching;
+    the load dropping brings bypass back.  max_batch=8 keeps the
+    engage threshold (max(break-even, max_batch/2)) within reach of a
+    16-thread burst regardless of this box's measured timing curve."""
+    eng, cfg = _light_engine(max_batch=8)
+    try:
+        eng.warmup()
+        x = np.ones(cfg.num_features, np.float32)
+        for _ in range(30):
+            eng.predict(x)
+        s = eng.stats()
+        assert s["mode"] == "bypass", s
+        assert s["bypasses"] > 0
+        assert s["break_even"] >= 1.0
+
+        def drive():
+            for _ in range(60):
+                eng.predict(x)
+
+        ths = [threading.Thread(target=drive) for _ in range(16)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        s = eng.stats()
+        # multi-row queued serves happen ONLY once the demand estimate
+        # clears the engage threshold (the serial regime drains one row
+        # per cycle), so average queued occupancy > 1 proves the burst
+        # re-engaged batching — without racing the instantaneous mode,
+        # which decays back toward bypass as the client threads finish
+        queued_serves = s["batches"] - s["bypasses"]
+        queued_rows = s["requests"] - s["bypasses"]
+        assert queued_serves > 0, s
+        assert queued_rows / queued_serves > 1.2, s
+
+        for _ in range(60):
+            eng.predict(x)
+        assert eng.stats()["mode"] == "bypass"
+    finally:
+        eng.close()
+
+
+def test_auto_off_keeps_legacy_batching():
+    """--no-serve-auto: a warmed engine still never bypasses — every
+    request takes the queue and the full configured window."""
+    eng, cfg = _light_engine(max_batch=16, auto=False)
+    try:
+        eng.warmup()
+        x = np.ones(cfg.num_features, np.float32)
+        for _ in range(20):
+            eng.predict(x)
+        s = eng.stats()
+        assert s["bypasses"] == 0
+        assert s["mode"] == "batch"
+    finally:
+        eng.close()

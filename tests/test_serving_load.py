@@ -36,8 +36,13 @@ def make_engine(**kw):
 
 def stall_dispatch(engine, hold: threading.Event, model_id: int = 0):
     """Replace the tenant's jit'd forward with one that blocks on
-    `hold` — admitted requests pile up behind it deterministically."""
+    `hold` — admitted requests pile up behind it deterministically.
+    Pins the engine to the queued path (auto off): warmup calibrates
+    the dispatch cost model, and an adaptive engine would otherwise
+    bypass-serve the first request inline on the submitting thread —
+    blocking the test on `hold` instead of piling up the queue."""
     engine.warmup(model_id)
+    engine.auto = False
     tenant = engine._tenants[model_id]
     inner = tenant.predict
 

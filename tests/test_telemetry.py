@@ -308,3 +308,40 @@ def test_gate_histograms_populated_per_model():
         assert snap["gate_wait_ms"][label]["count"] > 0
         assert snap["clock_lag"][label]["count"] > 0
         assert sum(snap["gradients_applied_total"].values()) > 0
+
+
+def test_serving_dispatch_mode_counter_family():
+    """serving_dispatch_mode{mode=batch|bypass} counts every dispatch
+    by the mode the engine chose (the shm child is incremented by the
+    bridge's shm serve loop, covered in test_net_framing)."""
+    import jax.numpy as jnp
+
+    from kafka_ps_tpu.models.task import get_task
+    from kafka_ps_tpu.serving.engine import PredictionEngine
+    from kafka_ps_tpu.serving.snapshot import SnapshotRegistry
+    from kafka_ps_tpu.utils.config import ModelConfig
+
+    cfg = ModelConfig(num_features=4, num_classes=2)
+    task = get_task("logreg", cfg)
+    theta = jnp.asarray(np.random.default_rng(3)
+                        .normal(size=task.num_params).astype(np.float32))
+    registry = SnapshotRegistry()
+    registry.publish(theta, vector_clock=1)
+    telemetry = Telemetry()
+    engine = PredictionEngine(task, registry, telemetry=telemetry)
+    x = np.zeros(cfg.num_features, np.float32)
+    try:
+        engine.warmup()                   # calibrated: singles bypass
+        for _ in range(5):
+            engine.predict(x)
+        # pin demand above break-even: the queued path takes over
+        engine._tenants[0].cost.demand = 1e9
+        for _ in range(3):
+            engine.predict(x)
+    finally:
+        engine.close()
+    snap = telemetry.snapshot()
+    s = engine.stats()
+    assert snap["serving_dispatch_mode"]["mode=bypass"] == s["bypasses"] == 5
+    assert snap["serving_dispatch_mode"]["mode=batch"] == 3
+    assert s["requests"] == 8
