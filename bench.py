@@ -70,6 +70,7 @@ KNOWN_BLOCKS = (
     "compression_ab",
     "sharding_ab",
     "slab_ab",
+    "tiering_ab",
     "telemetry_overhead",
     "flight_overhead",
     "staleness",
@@ -861,6 +862,142 @@ def slab_ab(iters: int = 30, warm: int = 5) -> dict:
     return out
 
 
+def tiering_ab(pages: int = 128, page_params: int = 2048,
+               rounds: int = 8, sweep_pins: int = 24) -> dict:
+    """Tiered parameter store A/B (kafka_ps_tpu/store/,
+    docs/TIERING.md): a 1 MiB parameter slice under hot+warm caps of
+    1/16 each — residency must shrink >= 5x while every value read
+    stays bitwise-exact.
+
+    Two arms:
+      * store-level skew drive: 90% of pins hammer an 8-page hot set
+        (rotated mid-run to force promotion churn), 10% sweep the
+        tail; reports per-tier pin hit rates, cold-fault and hot-pin
+        latency, and the resident-bytes ratio.
+      * end-to-end bitwise: the tiny logreg app capped at ~1/10 of its
+        parameter bytes vs fully resident, for all three consistency
+        models — final theta must be byte-identical (the tier replay
+        contract, scripts/tier1.sh --tier).
+    """
+    import shutil
+    import tempfile
+
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+    from kafka_ps_tpu.runtime.messages import KeyRange
+    from kafka_ps_tpu.store import TIER_COLD, ColdStore, TieredParamStore
+    from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
+                                           PSConfig, StreamConfig,
+                                           TierConfig)
+
+    tmp = tempfile.mkdtemp(prefix="kps-tier-bench-")
+    try:
+        # -- arm 1: skewed access against a capped store ---------------
+        n = pages * page_params
+        total_bytes = n * 4
+        rng = np.random.default_rng(11)
+        values = rng.normal(size=n).astype(np.float32)
+        cold = ColdStore.open(f"{tmp}/cold-skew")
+        store = TieredParamStore(
+            values, KeyRange(0, n),
+            hot_bytes=total_bytes // 16, warm_bytes=total_bytes // 16,
+            page_params=page_params, cold=cold)
+        hot_set = list(range(8))
+        fault_ms: list[float] = []
+        hot_ms: list[float] = []
+        for r in range(rounds):
+            if r == rounds // 2:       # shift the working set: the
+                hot_set = list(range(64, 72))   # policy must chase it
+            for _ in range(12):        # 90/10 skew, deterministic
+                for i in hot_set:
+                    t0 = time.perf_counter()
+                    store.pin(store.page_range(i))
+                    hot_ms.append((time.perf_counter() - t0) * 1e3)
+            for k in range(sweep_pins):
+                i = (r * sweep_pins + k) % pages
+                is_cold = store.residency_vector()[i] == TIER_COLD
+                t0 = time.perf_counter()
+                store.pin(store.page_range(i))
+                dt = (time.perf_counter() - t0) * 1e3
+                (fault_ms if is_cold else hot_ms).append(dt)
+            store.rebalance()
+        st = store.stats()
+        rb = st["resident_bytes"]
+        skew = {
+            "pages": pages, "page_params": page_params,
+            "total_mib": round(total_bytes / 2 ** 20, 2),
+            "hit_rate": st["hit_rate"],
+            "pins": st["pins"],
+            "promotions": st["promotions"],
+            "demotions": st["demotions"],
+            "faults": st["faults"],
+            "resident_ratio": round(rb["total"] / max(rb["resident"], 1),
+                                    1),
+            "fault_p50_ms": round(statistics.median(fault_ms), 3)
+            if fault_ms else None,
+            "hot_pin_p50_ms": round(statistics.median(hot_ms), 3),
+        }
+        store.close()
+
+        # -- arm 2: end-to-end bitwise at a 1/10 hot cap ---------------
+        def tiny_run(consistency: int, tier: TierConfig | None,
+                     tag: str):
+            cfg = PSConfig(
+                num_workers=2, consistency_model=consistency,
+                model=ModelConfig(num_features=8, num_classes=2),
+                buffer=BufferConfig(min_size=8, max_size=32),
+                stream=StreamConfig(time_per_event_ms=1.0),
+                tier=tier or TierConfig())
+            rng = np.random.default_rng(5)
+            y = rng.integers(1, 3, size=96).astype(np.int32)
+            centers = np.array([[0.0] * 8, [2.0] * 8, [-2.0] * 8],
+                               np.float32)
+            x = (centers[y] + rng.normal(scale=0.5, size=(96, 8))
+                 ).astype(np.float32)
+            app = StreamingPSApp(cfg, test_x=x, test_y=y)
+            store = app.enable_tiering(f"{tmp}/cold-{tag}"
+                                       if tier else None)
+            for i in range(len(x)):
+                app.data_sink(i % 2, {j: float(v) for j, v
+                                      in enumerate(x[i]) if v != 0},
+                              int(y[i]))
+            app.run_serial(max_server_iterations=16)
+            theta = np.asarray(app.server.theta).copy()
+            ratio = None
+            if store is not None:
+                # settle first: the final eval's replace_all lands cold
+                # pages warm until the next policy pass re-demotes
+                store.rebalance()
+                srb = store.resident_bytes()
+                ratio = round(srb["total"] / max(srb["resident"], 1), 1)
+            app.close_tiering()
+            return theta, ratio
+
+        # 27 params, page=2 -> 14 pages; hot 1 page, warm 1 page: ~1/10
+        cap = TierConfig(hot_bytes=2 * 4, warm_bytes=2 * 4,
+                         page_params=2, rebalance_interval_s=0.002)
+        e2e = {}
+        for c, name in ((0, "sequential"), (2, "bounded"),
+                        (-1, "eventual")):
+            base, _ = tiny_run(c, None, f"{name}-base")
+            capped, ratio = tiny_run(c, cap, name)
+            e2e[name] = {
+                "theta_bitwise_identical":
+                    capped.tobytes() == base.tobytes(),
+                "resident_ratio": ratio,
+            }
+        return {
+            "skew_drive": skew,
+            "e2e": e2e,
+            "all_bitwise": all(v["theta_bitwise_identical"]
+                               for v in e2e.values()),
+            "resident_ratio_min": min(
+                skew["resident_ratio"],
+                *(v["resident_ratio"] for v in e2e.values())),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def telemetry_overhead(iters: int = 40, trials: int = 9) -> dict:
     """Telemetry-overhead gate (docs/OBSERVABILITY.md): the SAME
     message-driven workload with instrumentation off (the default
@@ -1403,6 +1540,9 @@ def main() -> None:
         slab_roofs.append({"slab_dtype": sd,
                            "worker_updates_per_sec": ups, **roof})
 
+    # -- tiered parameter store A/B (docs/TIERING.md) ----------------------
+    tiering = tiering_ab()
+
     # -- telemetry plane: overhead gate + staleness distributions ----------
     telemetry = telemetry_overhead()
     flight = flight_overhead()
@@ -1439,6 +1579,7 @@ def main() -> None:
                 "compression_ab": compression,
                 "sharding_ab": sharding,
                 "slab_ab": slab,
+                "tiering_ab": tiering,
                 "telemetry_overhead": telemetry,
                 "flight_overhead": flight,
                 "staleness": staleness,
@@ -1510,6 +1651,10 @@ def main() -> None:
             "slab_bytes_ratio_f32": slab[
                 "f32_bytes_ratio_full_over_incremental"],
             "slab_int8_hbm_ratio": slab["int8_device_bytes_ratio_vs_f32"],
+            "tier_resident_ratio": tiering["resident_ratio_min"],
+            "tier_hot_hit_rate": tiering["skew_drive"]["hit_rate"]["hot"],
+            "tier_fault_p50_ms": tiering["skew_drive"]["fault_p50_ms"],
+            "tier_bitwise": tiering["all_bitwise"],
             "telemetry_overhead_pct": telemetry["overhead_pct"],
             "telemetry_bitwise": telemetry["theta_bitwise_identical"],
             "flight_overhead_pct": flight["max_overhead_pct"],

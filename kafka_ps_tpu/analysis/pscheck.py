@@ -16,13 +16,15 @@ time; these rules catch the regressions at commit time instead:
          on a non-literal receiver): messages carry verbatim
          ``encoded`` parts; int8 quantization is not idempotent.
   PS104  nondeterminism in replay-critical modules (``log/``,
-         ``compress/``, ``runtime/serde.py``, ``runtime/sharding.py``,
-         ``parallel/range_sharded.py``): wall clocks, ``random``,
-         ``np.random``, ``uuid``/``urandom``, and iteration over a
-         bare ``set(...)`` (hash order) — replay must be bitwise.
-         The sharding modules are replay-critical because per-shard
-         durable-log recovery is bitwise only if routing and assembly
-         order depend on (shard, worker, clock) alone.
+         ``compress/``, ``store/``, ``runtime/serde.py``,
+         ``runtime/sharding.py``, ``parallel/range_sharded.py``): wall
+         clocks, ``random``, ``np.random``, ``uuid``/``urandom``, and
+         iteration over a bare ``set(...)`` (hash order) — replay must
+         be bitwise.  The sharding modules are replay-critical because
+         per-shard durable-log recovery is bitwise only if routing and
+         assembly order depend on (shard, worker, clock) alone; the
+         tiered store because its promotion/demotion plan must be a
+         pure function of heat counters (docs/TIERING.md).
   PS105  blocking I/O (socket send/recv, frame send/recv, ``fsync``,
          ``time.sleep``) while holding a lock.
   PS106  host-sync calls (``.item()``, ``float()``, ``np.asarray``,
@@ -67,7 +69,7 @@ RULES: dict[str, str] = {
     "PS103": "re-encoding in serde.py/net.py of messages that carry "
              "verbatim encoded parts",
     "PS104": "nondeterminism in a replay-critical module "
-             "(log/, compress/, runtime/serde.py)",
+             "(log/, compress/, store/, runtime/serde.py)",
     "PS105": "blocking I/O while holding a lock",
     "PS106": "host-sync call inside the arguments of a telemetry/trace "
              "or flight-recorder call in runtime/, ops/ or serving/",
@@ -542,7 +544,7 @@ def _rules_for(path: Path) -> set:
         rules.add("PS106")
     if path.name in ("serde.py", "net.py"):
         rules.add("PS103")
-    if ("log" in parts or "compress" in parts
+    if ("log" in parts or "compress" in parts or "store" in parts
             or (path.name == "serde.py" and "runtime" in parts)
             or (path.name == "sharding.py" and "runtime" in parts)
             or (path.name == "range_sharded.py" and "parallel" in parts)):

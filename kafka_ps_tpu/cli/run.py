@@ -162,6 +162,28 @@ def build_parser(include_server_flags: bool = True,
                         "changes instead of scattering only dirty rows "
                         "(the pre-PERFORMANCE.md behavior; the A/B lever "
                         "behind the slab_ab bench block)")
+    p.add_argument("--tier-hot-bytes", dest="tier_hot_bytes", type=int,
+                   default=0, metavar="BYTES",
+                   help="tiered parameter residency (kafka_ps_tpu/store/, "
+                        "docs/TIERING.md): cap the device-resident (hot) "
+                        "tier of the server's parameter vector at BYTES; "
+                        "overflow pages live in pinned host RAM (warm).  "
+                        "0 = unbounded, today's fully-resident behavior.  "
+                        "Capped runs stay bitwise-identical — they only "
+                        "bound resident bytes.  Per process; split evenly "
+                        "across in-process shards.  Incompatible with "
+                        "--fused")
+    p.add_argument("--tier-warm-bytes", dest="tier_warm_bytes", type=int,
+                   default=0, metavar="BYTES",
+                   help="cap the host-RAM (warm) tier at BYTES; overflow "
+                        "pages demote to CRC-framed records in the commit "
+                        "log and fault back in on demand — requires "
+                        "--durable-log (the cold partition lives under "
+                        "it).  0 = unbounded")
+    p.add_argument("--tier-page-params", dest="tier_page_params", type=int,
+                   default=1024, metavar="KEYS",
+                   help="keys per residency page (the promotion/demotion "
+                        "unit; must match across checkpoint resumes)")
     p.add_argument("--no-gang", action="store_true", dest="no_gang",
                    help="disable gang-scheduled dispatch: process every "
                         "gate release as its own device step instead of "
@@ -268,7 +290,7 @@ def make_app_from_args(args, resuming: bool = False,
     from kafka_ps_tpu.runtime.app import StreamingPSApp
     from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
                                            PSConfig, ServingConfig,
-                                           StreamConfig)
+                                           StreamConfig, TierConfig)
     from kafka_ps_tpu.utils.csvlog import (CsvLogSink, NullLogSink,
                                            SERVER_HEADER, WORKER_HEADER)
 
@@ -301,6 +323,10 @@ def make_app_from_args(args, resuming: bool = False,
             shed_deadline_ms=getattr(args, "serve_shed_ms", 0.0),
             auto=getattr(args, "serve_auto", True),
             shm=getattr(args, "serve_shm", False)),
+        tier=TierConfig(
+            hot_bytes=getattr(args, "tier_hot_bytes", 0),
+            warm_bytes=getattr(args, "tier_warm_bytes", 0),
+            page_params=getattr(args, "tier_page_params", 1024)),
     )
     test_x, test_y = load_test_csv(args.test_data_file_path,
                                    args.num_features)
@@ -385,6 +411,25 @@ def run_with_args(args) -> int:
             "--slab-dtype applies to the per-node worker slab "
             "(compress/slab.py); the --fused BSP path keeps its own "
             "slab cache — drop one of the two flags")
+    tier_hot = getattr(args, "tier_hot_bytes", 0)
+    tier_warm = getattr(args, "tier_warm_bytes", 0)
+    if tier_hot < 0 or tier_warm < 0:
+        raise SystemExit("--tier-*-bytes caps must be >= 0")
+    if (tier_hot or tier_warm) and args.fused:
+        # the fused BSP step owns theta inside its shard_map program —
+        # paged residency has no seam there; silently ignoring the caps
+        # would misreport what ran
+        raise SystemExit(
+            "--tier-hot-bytes/--tier-warm-bytes apply to the per-node "
+            "server (kafka_ps_tpu/store/); the --fused BSP path keeps "
+            "theta inside its mesh program — drop one of the two flags")
+    if tier_warm and not getattr(args, "durable_log", None):
+        raise SystemExit(
+            "--tier-warm-bytes demotes pages to commit-log records; "
+            "run with --durable-log DIR so the cold partition has a "
+            "home (docs/TIERING.md)")
+    if getattr(args, "tier_page_params", 1024) < 1:
+        raise SystemExit("--tier-page-params must be >= 1")
     compress = getattr(args, "compress", "none") or "none"
     if compress != "none":
         from kafka_ps_tpu.compress.wire import parse_codec
@@ -448,6 +493,18 @@ def run_with_args(args) -> int:
                   if (args.logging and process_index == 0) else _Null())
     app.server.membership_log = events_log
     logs = [*logs, events_log]
+
+    if tier_hot or tier_warm:
+        # attach BEFORE the checkpoint restore below so the restore can
+        # re-apply the recorded tier residency (utils/checkpoint.py)
+        if distributed:
+            raise SystemExit(
+                "--tier-*-bytes is single-process (residency is a "
+                "per-process resource; multi-host runs are --fused)")
+        from kafka_ps_tpu.log.durable_fabric import COLD_PARTITION_DIR
+        cold_dir = (os.path.join(args.durable_log, COLD_PARTITION_DIR)
+                    if getattr(args, "durable_log", None) else None)
+        app.enable_tiering(cold_dir)
 
     if args.checkpoint:
         from kafka_ps_tpu.utils import checkpoint as ckpt
@@ -612,6 +669,9 @@ def run_with_args(args) -> int:
             # routed through the server so a durable fabric commits the
             # offsets this final snapshot covers (a commit point)
             app.server.save_checkpoint_now()
+        # AFTER the final checkpoint: saving assembles theta, which may
+        # fault cold pages and needs the cold log still open
+        app.close_tiering()
         if getattr(args, "durable_log", None):
             app.fabric.close()
         app.close_logs()

@@ -34,10 +34,17 @@ from kafka_ps_tpu.runtime import net
 def _make_cfg(args):
     from kafka_ps_tpu.cli.run import apply_platform_env
     from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
-                                           PSConfig, StreamConfig)
+                                           PSConfig, StreamConfig,
+                                           TierConfig)
     apply_platform_env()
     if getattr(args, "eval_every", 1) < 1:
         raise SystemExit("--eval_every must be >= 1")
+    if getattr(args, "tier_warm_bytes", 0) \
+            and not getattr(args, "durable_log", None):
+        raise SystemExit(
+            "--tier-warm-bytes demotes pages to commit-log records; "
+            "run with --durable-log DIR so the cold partition has a "
+            "home (docs/TIERING.md)")
     return PSConfig(
         num_workers=args.num_workers,
         consistency_model=getattr(args, "consistency_model", 0),
@@ -61,6 +68,10 @@ def _make_cfg(args):
         # per-message
         use_gang=False,
         compress=getattr(args, "compress", "none") or "none",
+        tier=TierConfig(
+            hot_bytes=getattr(args, "tier_hot_bytes", 0),
+            warm_bytes=getattr(args, "tier_warm_bytes", 0),
+            page_params=getattr(args, "tier_page_params", 1024)),
     )
 
 
@@ -71,6 +82,35 @@ def _codec_spec(args):
         return cwire.parse_codec(getattr(args, "compress", "none") or "none")
     except ValueError as e:
         raise SystemExit(f"--compress: {e}") from None
+
+
+def _attach_tier_store(server, cfg, key_range, cold_dir, telemetry):
+    """Attach tiered hot/warm/cold residency per cfg.tier
+    (kafka_ps_tpu/store/, docs/TIERING.md); no-op (None) when both caps
+    are 0.  Called BEFORE the checkpoint restore so the restore can
+    re-apply recorded residency.  Caller owns close() at teardown —
+    after the final checkpoint save, which may still fault cold
+    pages."""
+    if not cfg.tier.enabled:
+        return None
+    import numpy as np
+
+    from kafka_ps_tpu.store import ColdStore, TieredParamStore
+    t = cfg.tier
+    cold = ColdStore.open(cold_dir) if cold_dir is not None else None
+    store = TieredParamStore(
+        np.asarray(server.theta), key_range,
+        hot_bytes=t.hot_bytes, warm_bytes=t.warm_bytes,
+        page_params=t.page_params, cold=cold, telemetry=telemetry,
+        rebalance_interval_s=t.rebalance_interval_s)
+    server.attach_param_store(store)
+    store.start_policy_thread()
+    caps = {k: v for k, v in (("hot", t.hot_bytes),
+                              ("warm", t.warm_bytes)) if v}
+    print(f"tiered residency: caps {caps}, "
+          f"{store.num_pages} pages of {t.page_params} keys",
+          file=sys.stderr, flush=True)
+    return store
 
 
 def _make_telemetry(args):
@@ -256,6 +296,14 @@ def run_server(args) -> int:
     server.run_id = run_id
     server.membership_log = events_log   # before restore: it logs "resume"
 
+    from kafka_ps_tpu.log.durable_fabric import COLD_PARTITION_DIR
+    from kafka_ps_tpu.runtime.messages import KeyRange
+    tier_store = _attach_tier_store(
+        server, cfg, KeyRange(0, server.task.num_params),
+        cold_dir=(os.path.join(args.durable_log, COLD_PARTITION_DIR)
+                  if getattr(args, "durable_log", None) else None),
+        telemetry=telemetry)
+
     if checkpoint_path:
         from kafka_ps_tpu.utils import checkpoint as ckpt
         ckpt.maybe_restore(checkpoint_path, server)
@@ -431,6 +479,8 @@ def run_server(args) -> int:
         if checkpoint_path:
             from kafka_ps_tpu.utils import checkpoint as ckpt
             ckpt.save(checkpoint_path, server)
+        if tier_store is not None:
+            tier_store.close()   # after the save: it may fault cold pages
         if reroute["dropped"] or bridge.dropped_sends:
             print(f"dropped rows: {reroute['dropped']}, dropped sends: "
                   f"{bridge.dropped_sends}", file=sys.stderr, flush=True)
@@ -756,6 +806,11 @@ def run_server_shard(args) -> int:
                         key_range=key_range, shard_id=shard_id,
                         num_shards=num_shards)
     server.run_id = run_id
+    tier_store = _attach_tier_store(
+        server, cfg, key_range,
+        cold_dir=(inner.cold_dir()      # under the shard-suffixed root
+                  if getattr(inner, "durable", False) else None),
+        telemetry=telemetry)
     if checkpoint_path:
         ckpt.maybe_restore(checkpoint_path, server)
         server.checkpoint_path = checkpoint_path
@@ -870,6 +925,8 @@ def run_server_shard(args) -> int:
             # the same instant (ServerNode.save_checkpoint_now commits
             # a durable fabric's offsets after the save)
             server.save_checkpoint_now()
+        if tier_store is not None:
+            tier_store.close()   # after the save: it may fault cold pages
         if getattr(inner, "durable", False):
             inner.close()
         if reroute["dropped"] or bridge.dropped_sends:

@@ -247,3 +247,54 @@ class SlabStore:
         else:
             xb = self._x.nbytes
         return xb + self._y.nbytes + self._mask.nbytes
+
+
+class ParamPageSlab:
+    """Hot-tier device residency for the tiered parameter store
+    (kafka_ps_tpu/store/, docs/TIERING.md): page index -> f32 device
+    array, with the same measured-bytes discipline as SlabStore —
+    `bytes_uploaded` counts actual host->device traffic and
+    `device_bytes()` the resident HBM footprint, so the tiering_ab
+    bench audits counters, not estimates.
+
+    This is SlabStore's parameter-side sibling: per-PAGE residency of
+    the server's theta slice instead of the worker's full training
+    slab.  Values are immutable device arrays replaced wholesale (the
+    theta replacement contract, runtime/server.py), so readers may
+    hold a fetched reference without locking."""
+
+    def __init__(self):
+        self._pages: dict[int, jax.Array] = {}
+        self.bytes_uploaded = 0
+        self.uploads = 0
+
+    def __contains__(self, page: int) -> bool:
+        return page in self._pages
+
+    def __len__(self) -> int:
+        return len(self._pages)
+
+    def put(self, page: int, values) -> jax.Array:
+        """Install a page value; host arrays are uploaded (counted),
+        device arrays (a jit apply's output) are stored as-is —
+        the steady-state hot path moves zero host bytes."""
+        if isinstance(values, np.ndarray):
+            host = np.ascontiguousarray(values, dtype=np.float32)
+            self.bytes_uploaded += host.nbytes
+            self.uploads += 1
+            values = jnp.asarray(host)
+        self._pages[page] = values
+        return values
+
+    def get(self, page: int) -> jax.Array:
+        return self._pages[page]
+
+    def pop_host(self, page: int) -> np.ndarray:
+        """Demotion fetch: device -> host, page leaves the slab."""
+        return np.asarray(self._pages.pop(page), dtype=np.float32)
+
+    def drop(self, page: int) -> None:
+        self._pages.pop(page, None)
+
+    def device_bytes(self) -> int:
+        return sum(a.nbytes for a in self._pages.values())

@@ -25,6 +25,8 @@ tracker (runtime/server.ServerNode.process).
 
 from __future__ import annotations
 
+import os
+
 from kafka_ps_tpu.log.log import LogConfig
 from kafka_ps_tpu.log.manager import LogManager, partition_key
 from kafka_ps_tpu.runtime import serde
@@ -37,6 +39,17 @@ GROUP_OF_TOPIC = {
     WEIGHTS_TOPIC: "workers",
     INPUT_DATA_TOPIC: "ingest",
 }
+
+# Directory name reserved under the durable root for the tiered store's
+# cold partition (kafka_ps_tpu/store/cold.py, docs/TIERING.md).  It is
+# NOT a fabric topic: its records are raw page bytes, not serde frames;
+# no consumer group ever commits offsets for it (so retention can never
+# reap a record a live page or checkpoint still references); and
+# recovery must never replay it into the message queues.  LogManager
+# discovery already ignores it — its segment files sit directly in the
+# directory, not under digit-named key subdirs — but the name is
+# reserved here so no future topic claims it.
+COLD_PARTITION_DIR = "param-cold"
 
 
 class DurableFabric(Fabric):
@@ -61,6 +74,12 @@ class DurableFabric(Fabric):
         # position set by recover() and advances on every poll
         self._delivered: dict[tuple[str, int], int] = {}
         self._recovered = False
+
+    def cold_dir(self) -> str:
+        """The reserved cold-partition directory under this fabric's
+        root — co-located so one `--durable-log DIR` carries both the
+        message log and the tiered store's cold pages."""
+        return os.path.join(self.manager.root, COLD_PARTITION_DIR)
 
     # -- producer side -----------------------------------------------------
 
@@ -219,6 +238,8 @@ class DurableFabric(Fabric):
         weights_cache: dict[bytes, object] = {}
         with self._cond:
             for topic, key in self.manager.partitions():
+                if topic == COLD_PARTITION_DIR:   # raw page bytes, not
+                    continue                      # serde frames
                 start = self.start_offset(topic, key, checkpoint_offsets)
                 self._delivered[(topic, key)] = start
                 if topic == INPUT_DATA_TOPIC:

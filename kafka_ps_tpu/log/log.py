@@ -24,6 +24,7 @@ by age or size alone: an unconsumed record is never dropped.
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 import os
 import time
@@ -168,6 +169,17 @@ class CommitLog:
             if nxt is not None and nxt <= offset:
                 continue               # fully below the target
             yield from seg.read_from(offset)
+
+    def read_at(self, offset: int) -> bytes:
+        """CRC-verified point read of the single record at `offset` —
+        bisect the owning segment by base offset, sparse-index seek
+        inside it (LogSegment.read_at).  Raises KeyError for offsets
+        below retention, past the tail, or failing CRC."""
+        bases = [seg.base_offset for seg in self.segments]
+        i = bisect.bisect_right(bases, offset) - 1
+        if i < 0:
+            raise KeyError(offset)     # below the retained start offset
+        return self.segments[i].read_at(offset)
 
     # -- retention ---------------------------------------------------------
 

@@ -60,6 +60,21 @@ def unpack_record(buf: bytes, pos: int) -> tuple[int, bytes, int] | None:
     return offset, bytes(payload), end
 
 
+def peek_header(buf: bytes, pos: int) -> tuple[int, int] | None:
+    """(offset, payload_length) from the 16-byte header at `pos`, or
+    None if the header is truncated or obviously corrupt.  Does NOT
+    verify the CRC — this is the cheap skip-scan primitive positioned
+    point reads (`LogSegment.read_at`) use to hop record-to-record from
+    an index floor without touching payload bytes; the target record
+    itself is always CRC-verified via `unpack_record`."""
+    if pos + HEADER_SIZE > len(buf):
+        return None
+    offset, length = _PREFIX.unpack_from(buf, pos)
+    if length > MAX_RECORD_BYTES or offset < 0:
+        return None
+    return offset, length
+
+
 def scan(buf: bytes, pos: int = 0):
     """Yield (offset, payload, record_pos) for the valid record prefix
     of `buf` starting at `pos`; stops at the first invalid record."""

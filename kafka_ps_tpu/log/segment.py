@@ -127,6 +127,42 @@ class LogSegment:
             if rec_offset >= offset:
                 yield rec_offset, payload
 
+    def read_at(self, offset: int) -> bytes:
+        """CRC-verified point read of the single record at `offset`.
+
+        The positioned-read primitive the tiered store's cold tier is
+        built on (docs/TIERING.md): binary-search the sparse index for
+        the floor position, then hop header-to-header (records.py
+        `peek_header` — 16 bytes per hop, no payload reads) until the
+        target record, and CRC-verify only that one.  At most one
+        `index_interval_bytes` of headers is walked.
+
+        Raises KeyError if `offset` is outside the segment's recovered
+        range or the record at it fails CRC — a torn tail past the
+        recovery point is "not present", never garbage bytes.
+        """
+        if not self.base_offset <= offset < self.next_offset:
+            raise KeyError(offset)
+        self._fh.flush()
+        with open(self.log_path, "rb") as fh:
+            pos = self.seek_position(offset)
+            while True:
+                fh.seek(pos)
+                header = fh.read(records.HEADER_SIZE)
+                peeked = records.peek_header(header, 0)
+                if peeked is None:
+                    raise KeyError(offset)        # torn/corrupt tail
+                rec_offset, length = peeked
+                if rec_offset > offset:
+                    raise KeyError(offset)        # hole: offset skipped
+                if rec_offset == offset:
+                    rec = records.unpack_record(
+                        header + fh.read(length), 0)
+                    if rec is None:
+                        raise KeyError(offset)    # CRC mismatch
+                    return rec[1]
+                pos += records.HEADER_SIZE + length
+
     def delete(self) -> None:
         self.close()
         for p in (self.log_path, self.index_path):

@@ -253,6 +253,38 @@ class StreamingPSApp:
         if self.serving_engine is not None:
             self.serving_engine.close()
 
+    # -- tiered residency (kafka_ps_tpu/store/, docs/TIERING.md) -----------
+
+    def enable_tiering(self, cold_dir: str | None = None):
+        """Attach a TieredParamStore to the server per cfg.tier and
+        start its policy thread.  `cold_dir` hosts the cold partition
+        (required when the warm tier is capped; under --durable-log the
+        CLI passes `<log-dir>/param-cold`).  No-op when both caps are 0
+        — theta stays fully resident.  Returns the store (or None)."""
+        if not self.cfg.tier.enabled:
+            return None
+        if self.server.param_store is not None:
+            return self.server.param_store
+        from kafka_ps_tpu.runtime.messages import KeyRange
+        from kafka_ps_tpu.store import ColdStore, TieredParamStore
+        tcfg = self.cfg.tier
+        cold = ColdStore.open(cold_dir) if cold_dir is not None else None
+        store = TieredParamStore(
+            np.asarray(self.server.theta),
+            KeyRange(0, self.server.task.num_params),
+            hot_bytes=tcfg.hot_bytes, warm_bytes=tcfg.warm_bytes,
+            page_params=tcfg.page_params, cold=cold,
+            telemetry=self.telemetry,
+            rebalance_interval_s=tcfg.rebalance_interval_s)
+        self.server.attach_param_store(store)
+        store.start_policy_thread()
+        return store
+
+    def close_tiering(self) -> None:
+        """Join the policy thread and close an owned cold log."""
+        if self.server.param_store is not None:
+            self.server.param_store.close()
+
     # -- membership --------------------------------------------------------
 
     def readmit_worker(self, worker_id: int) -> int:

@@ -112,6 +112,10 @@ class ServerNode:
         self.task = get_task(cfg.task, cfg.model)
         self._range = (key_range if key_range is not None
                        else KeyRange(0, self.task.num_params))
+        # optional tiered residency (kafka_ps_tpu/store/, docs/
+        # TIERING.md): None keeps theta a plain device array — today's
+        # fully-resident behavior, byte for byte
+        self.param_store = None
         # device-resident; updated by replacement only (see module doc).
         # A shard owns only its slice of the init vector (the slice of a
         # host ndarray is a view — same bits as the full init).
@@ -192,6 +196,45 @@ class ServerNode:
         # bitwise-identical with serving on or off.
         self.serving = None
 
+    # -- tiered residency (kafka_ps_tpu/store/, docs/TIERING.md) -----------
+
+    @property
+    def theta(self):
+        """The owned parameter slice.  A direct array when fully
+        resident (today's behavior); assembled on demand from the
+        tiered store when one is attached.  Either way the value is
+        immutable-by-contract — readers may alias it, writers go
+        through the setter (replacement only, see module doc)."""
+        if self.param_store is not None:
+            return self.param_store.assembled()
+        return self._theta
+
+    @theta.setter
+    def theta(self, value):
+        if self.param_store is not None:
+            self.param_store.replace_all(value)
+            return
+        self._theta = value
+
+    def attach_param_store(self, store) -> None:
+        """Switch this node's slice to tiered hot/warm/cold residency.
+        Seeds the store from the current theta (attach-any-time is
+        safe: before or after a checkpoint restore); afterwards dense
+        applies run per page and the configured byte caps bound what
+        stays device/host resident while every computed bit stays
+        identical (the tier replay contract, docs/TIERING.md)."""
+        if (store.key_range.start != self._range.start
+                or store.key_range.end != self._range.end):
+            raise ValueError(
+                f"store range [{store.key_range.start}, "
+                f"{store.key_range.end}) != shard range "
+                f"[{self._range.start}, {self._range.end})")
+        # pscheck: disable=PS102 (one-time seed at attach, not the hot path)
+        store.replace_all(np.asarray(self._theta))
+        self.param_store = store
+        self._theta = None           # the store owns the values now
+        store.rebalance()            # settle residency under the caps
+
     # -- bootstrap (ServerProcessor.java:75-87) ----------------------------
 
     def start_training_loop(self) -> None:
@@ -248,12 +291,17 @@ class ServerNode:
         self.publish_snapshot()
 
     def _weights_message(self, vector_clock: int) -> WeightsMessage:
-        # device theta is immutable — safe to alias; a host-side theta
-        # (checkpoint restore, partial-range splice) is copied so a
-        # later in-place edit can't race an in-flight message
-        # pscheck: disable=PS102 (host->host defensive copy, no device sync)
-        values = (np.array(self.theta)
-                  if isinstance(self.theta, np.ndarray) else self.theta)
+        if self.param_store is not None:
+            # assembled() is a FRESH host vector per call — nothing else
+            # aliases it, so no defensive copy is needed
+            values = self.param_store.assembled()
+        else:
+            # device theta is immutable — safe to alias; a host-side
+            # theta (checkpoint restore, partial-range splice) is copied
+            # so a later in-place edit can't race an in-flight message
+            # pscheck: disable=PS102 (host->host defensive copy, no device sync)
+            values = (np.array(self.theta)
+                      if isinstance(self.theta, np.ndarray) else self.theta)
         encoded = None
         if self.compressor is not None:
             # every worker trains on the decoded (quantize-dequantized)
@@ -475,7 +523,10 @@ class ServerNode:
                 # sync — eval iterations fuse the evaluation in (the
                 # nested span keeps server.eval visible to --trace
                 # consumers even though the dispatch is shared)
-                if want_eval:
+                if self.param_store is not None:
+                    m = self._apply_tiered(msg.values, want_eval,
+                                           msg.vector_clock)
+                elif want_eval:
                     with self.tracer.span("server.eval",
                                           clock=msg.vector_clock):
                         self.theta, m = self._apply_full_eval(
@@ -527,6 +578,35 @@ class ServerNode:
 
         self.maybe_checkpoint()
 
+    def _apply_tiered(self, delta, want_eval: bool, clock: int):
+        """Full-range dense apply against the tiered store.
+
+        Non-eval: per-page `t_p + lr * d_p` dispatches.  `_apply_full`
+        is pointwise, so page-sliced applies produce bitwise-identical
+        elements to the one full-slice apply — the tier bitwise
+        contract (docs/TIERING.md).  Hot pages update device-to-device;
+        warm/cold pages are materialized by the store (cold ones fault
+        in from the log).
+
+        Eval: assemble once and run the SAME fused `_apply_full_eval`
+        program as the resident path, then scatter the result back —
+        identical jaxpr on identical input bits, so the CSV metrics
+        row matches the fully-resident run exactly."""
+        store = self.param_store
+        if want_eval:
+            with self.tracer.span("server.eval", clock=clock):
+                t2, m = self._apply_full_eval(
+                    jnp.asarray(store.assembled()), delta,
+                    self.test_x, self.test_y)
+                store.replace_all(t2)
+            return m
+        base = self._range.start
+        for i, kr, value in store.pin_pages(self._range):
+            lo, hi = kr.start - base, kr.end - base
+            store.update_page(i, self._apply_full(jnp.asarray(value),
+                                                  delta[lo:hi]))
+        return None
+
     def _apply_sparse(self, msg, fid) -> None:
         """Apply a SparseDeltaMessage slice: theta[idx] += lr * vals as
         ONE jit'd scatter-add, compiled per padded bucket size (next
@@ -540,6 +620,8 @@ class ServerNode:
         k = len(msg.indices)
         if k == 0:
             self.tracer.count("dispatch.skipped_empty_slice")
+        elif self.param_store is not None:
+            self._apply_sparse_tiered(msg)
         else:
             bucket = 1 << max(3, int(k - 1).bit_length())
             idx = np.zeros((bucket,), dtype=np.int32)
@@ -555,6 +637,34 @@ class ServerNode:
             self.tracer.flow_step("delta.wire", fid,
                                   clock=msg.vector_clock,
                                   shard=self.shard_id)
+
+    def _apply_sparse_tiered(self, msg) -> None:
+        """Sparse scatter against the tiered store: group the slice's
+        surviving indices by page (np.unique — sorted, deterministic)
+        and run the bucketed scatter-add per touched page.  Pages the
+        survivor set skips stay untouched — and therefore cool: this
+        access skew is exactly what the heat policy feeds on
+        (docs/TIERING.md)."""
+        store = self.param_store
+        # pscheck: disable=PS102 (wire slices are host arrays; no device sync)
+        idx = np.asarray(msg.indices, dtype=np.int64)
+        # pscheck: disable=PS102 (wire slices are host arrays; no device sync)
+        vals = np.asarray(msg.values, dtype=np.float32)
+        pages = idx // store.page_params
+        for page in np.unique(pages):
+            page = int(page)
+            sel = pages == page
+            local = (idx[sel] - page * store.page_params).astype(np.int32)
+            n = len(local)
+            bucket = 1 << max(3, int(n - 1).bit_length())
+            bidx = np.zeros((bucket,), dtype=np.int32)
+            bvals = np.zeros((bucket,), dtype=np.float32)
+            bidx[:n] = local
+            bvals[:n] = vals[sel]
+            (_, _, value), = store.pin_pages(store.page_range(page))
+            store.update_page(page, self._sparse_apply_fn(bucket)(
+                jnp.asarray(value), bidx, bvals))
+        self.tracer.count("dispatch.device")
 
     def _sparse_apply_fn(self, bucket: int):
         fn = self._sparse_apply_cache.get(bucket)
@@ -628,6 +738,15 @@ class ServerNode:
         bitwise contract.  Partial-range gradients (range sharding)
         fall back to per-message processing.
         """
+        if self.param_store is not None:
+            # the gang chain wants the whole slice in one device array;
+            # with tiered residency attached, fall back to per-message
+            # processing — bitwise-equivalent by the gang contract
+            # itself (docs/GANG_DISPATCH.md, tests/test_gang.py), just
+            # without the k-1 round-trip saving
+            for m in msgs:
+                self.process(m)
+            return
         full = all(getattr(m, "indices", None) is None
                    and m.key_range.start == self._range.start
                    and m.key_range.end == self._range.end

@@ -379,11 +379,26 @@ class ShardedServerGroup:
         return np.concatenate(
             [np.asarray(s.theta) for s in self.shards])
 
-    def snapshot_cut(self) -> list[tuple[np.ndarray, int]]:
-        """The consistent-cut vector: per-shard (theta_slice, clock)
-        in shard-id order, read at one drive-loop quiescent point."""
-        return [(np.asarray(s.theta), s.serving_clock())
+    def snapshot_cut(self) -> list[tuple]:
+        """The consistent-cut vector: per-shard (theta reader, clock)
+        in shard-id order, read at one drive-loop quiescent point.
+        The slice is LAZY (a zero-arg callable): FrontierCutPublisher
+        materializes only when the frontier actually advanced — with
+        tiered residency attached (docs/TIERING.md), reading a slice
+        assembles pages and faults cold ones, so the cuts that publish
+        nothing must not touch the stores."""
+        return [((lambda s=s: np.asarray(s.theta)), s.serving_clock())
                 for s in self.shards]
+
+    def attach_param_stores(self, make_store) -> None:
+        """Tiered residency per shard (kafka_ps_tpu/store/): each shard
+        gets its own TieredParamStore over its range — built by
+        `make_store(shard)` so the caller decides per-shard budgets and
+        cold partitions (residency is a per-process resource; the CLI
+        splits a process's byte caps evenly across its in-process
+        shards, docs/TIERING.md)."""
+        for s in self.shards:
+            s.attach_param_store(make_store(s))
 
     # -- serving / eval at the frontier ------------------------------------
 

@@ -175,14 +175,18 @@ class FrontierCutPublisher:
         self._last_frontier = -1
 
     def maybe_publish(self, cut, trace=None) -> Snapshot | None:
-        """`cut`: [(theta_slice, clock), ...] in shard-id order.  The
+        """`cut`: [(theta_slice, clock), ...] in shard-id order; a
+        slice may be a zero-arg callable evaluated only on publication
+        (lazy cuts, ShardedServerGroup.snapshot_cut — a tiered store
+        must not assemble pages for a cut that publishes nothing).  The
         frontier is min(clock); publishes and returns the snapshot when
         it advanced, else None (no torn/duplicate publications)."""
         import numpy as np
         frontier = min(clock for _, clock in cut)
         if frontier <= self._last_frontier:
             return None
-        theta = np.concatenate([np.asarray(s) for s, _ in cut])
+        theta = np.concatenate([np.asarray(s() if callable(s) else s)
+                                for s, _ in cut])
         snap = self.registry.publish(theta, frontier, trace=trace)
         self._last_frontier = frontier
         return snap

@@ -1,0 +1,11 @@
+"""Tiered parameter store: hot (device slab) / warm (host RAM) /
+cold (commit-log records) residency for per-server theta slices, so
+parameter spaces outgrow HBM without changing a single computed bit
+(docs/TIERING.md)."""
+
+from kafka_ps_tpu.store.cold import ColdStore
+from kafka_ps_tpu.store.tiered import (TIER_COLD, TIER_HOT, TIER_NAMES,
+                                       TIER_WARM, TieredParamStore)
+
+__all__ = ["ColdStore", "TieredParamStore", "TIER_HOT", "TIER_WARM",
+           "TIER_COLD", "TIER_NAMES"]

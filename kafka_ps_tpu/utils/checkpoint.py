@@ -87,7 +87,24 @@ def _atomic_savez(path: str, arrays: dict) -> None:
 
 def save(path: str, server, buffers=None, log_offsets=None,
          residuals=None) -> None:
-    arrays = dict(
+    arrays = {}
+    store = getattr(server, "param_store", None)
+    if store is not None:
+        # tiered residency (kafka_ps_tpu/store/): record which pages
+        # were hot/warm/cold plus their heat so recovery resumes with
+        # the same residency it crashed with.  Captured BEFORE theta —
+        # assembling the full slice below faults every cold page warm,
+        # so the other order would record "everything resident" and
+        # restores would never re-demote.  Values are tier-invariant,
+        # so these arrays can never affect the restored theta — they
+        # only skip the policy's warm-up
+        reads, writes = store.heat_vectors()
+        arrays["tier_residency"] = store.residency_vector()
+        arrays["tier_reads"] = reads
+        arrays["tier_writes"] = writes
+        arrays["tier_page_params"] = np.asarray(store.page_params,
+                                                dtype=np.int64)
+    arrays.update(
         theta=server.theta,
         clocks=np.asarray(server.tracker.clocks, dtype=np.int64),
         sent=np.asarray([s.weights_message_sent for s in server.tracker.tracker],
@@ -131,6 +148,19 @@ def restore(path: str, server, buffers=None, residuals=None) -> None:
             server.restored_log_offsets = {
                 k: int(v) for k, v
                 in json.loads(str(z["log_offsets"])).items()}
+        store = getattr(server, "param_store", None)
+        if store is not None and "tier_residency" in z.files:
+            if int(z["tier_page_params"]) != store.page_params:
+                raise ValueError(
+                    f"checkpoint page size {int(z['tier_page_params'])} "
+                    f"!= store page size {store.page_params}")
+            # re-apply recorded residency AFTER the theta assignment
+            # above scattered the restored values in (every page landed
+            # hot/warm); recorded-cold pages are RE-demoted with fresh
+            # log appends, so the checkpoint never references cold
+            # records a crash may have torn off the log tail
+            store.set_residency(z["tier_residency"], z["tier_reads"],
+                                z["tier_writes"])
         _unpack_buffers(z, buffers)
         _unpack_residuals(z, residuals)
     # the crash killed every in-flight message; start_training_loop

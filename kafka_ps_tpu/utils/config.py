@@ -81,6 +81,29 @@ class ServingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TierConfig:
+    """Tiered parameter residency (kafka_ps_tpu/store/,
+    docs/TIERING.md): byte caps for the hot (device) and warm (host
+    RAM) tiers; everything over the caps lives as commit-log records
+    (cold).  `--tier-hot-bytes` / `--tier-warm-bytes` in cli/run.py.
+
+    0 = unbounded — the fully-resident default, byte for byte today's
+    behavior (no store is even constructed).  A warm cap needs a cold
+    log to overflow into, so warm_bytes > 0 requires --durable-log (or
+    a standalone cold directory).  Caps are PER PROCESS: a process
+    hosting several in-process shards splits them evenly."""
+
+    hot_bytes: int = 0
+    warm_bytes: int = 0
+    page_params: int = 1024        # keys per page (the residency unit)
+    rebalance_interval_s: float = 0.05   # policy-thread cadence
+
+    @property
+    def enabled(self) -> bool:
+        return self.hot_bytes > 0 or self.warm_bytes > 0
+
+
+@dataclasses.dataclass(frozen=True)
 class PSConfig:
     """Top-level parameter-server configuration (BaseKafkaApp.java:25,
     ServerProcessor.java:36,45-49)."""
@@ -132,6 +155,12 @@ class PSConfig:
     # immutable device theta), but the engine thread only exists when
     # asked for.
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    # Tiered parameter residency (kafka_ps_tpu/store/): disabled (both
+    # caps 0) keeps theta fully device-resident — bitwise-identical to
+    # a build without the feature; capped runs stay bitwise-identical
+    # too (the tier replay contract, docs/TIERING.md), they just bound
+    # resident bytes.
+    tier: TierConfig = dataclasses.field(default_factory=TierConfig)
 
     @property
     def server_lr(self) -> float:

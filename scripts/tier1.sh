@@ -45,6 +45,17 @@
 # low concurrency with p99 no worse than a hand-tuned unbatched
 # engine (docs/SERVING.md, "Dispatch economics").
 #
+# `scripts/tier1.sh --tier` runs the tiered-parameter-store smoke leg
+# (docs/TIERING.md): train through the public CLI with the hot tier
+# capped at ~1/13 of the parameter bytes (+ a warm cap, so most pages
+# live as commit-log records), for all three consistency models,
+# asserting final theta AND the eval CSV (timestamp column stripped)
+# bitwise-equal to the uncapped run; then SIGKILL a capped durable run
+# mid-training, restart it, and prove bitwise recovery by replaying the
+# gradients partition through a fresh fully-resident ServerNode against
+# the restarted run's final checkpoint — whose recorded residency must
+# still hold cold pages (faulted in on demand, never pre-materialized).
+#
 # `scripts/tier1.sh --analyze` runs the static-analysis leg: pscheck
 # (docs/ANALYSIS.md) over the package — fails on ANY unsuppressed
 # finding — plus ruff (pyproject.toml, rule sets E/F/B/PLE) when the
@@ -862,6 +873,168 @@ for c in (0, 2, -1):
     # bf16 slab storage trains end-to-end on every consistency model
     run(c, "bf16", incremental=True)
 print("PERF_SMOKE_OK f32 bitwise + bf16 e2e at consistency 0/2/-1")
+EOF
+    exit $?
+fi
+
+if [[ "${1:-}" == "--tier" ]]; then
+    timeout -k 10 540 env JAX_PLATFORMS=cpu python - <<'EOF'
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+# tiered-store smoke (docs/TIERING.md), all through the public CLI.
+# Phase A: for each consistency model, an uncapped run vs a run whose
+# hot tier holds ~1/13 of the parameter bytes (1 of 14 pages; warm 2
+# more; the other 11 live as cold commit-log records) must produce
+# bitwise-identical theta AND an identical eval CSV (timestamps
+# stripped).  Phase B: SIGKILL a capped durable run mid-training,
+# restart it, and replay its gradients partition through a fresh FULLY
+# RESIDENT ServerNode — recovered-capped theta must equal the resident
+# replay bit for bit.
+root = tempfile.mkdtemp(prefix="kps-tier-")
+repo = os.getcwd()
+rng = np.random.default_rng(0)
+x = rng.normal(size=(256, 8)).astype(np.float32)
+y = (x[:, 0] > 0).astype(np.int32) + 1
+train, test = os.path.join(root, "train.csv"), os.path.join(root, "test.csv")
+for path, (xx, yy) in ((train, (x[:200], y[:200])),
+                       (test, (x[200:], y[200:]))):
+    with open(path, "w") as fh:
+        fh.write(",".join(f"f{i}" for i in range(8)) + ",Score\n")
+        for r, lab in zip(xx, yy):
+            fh.write(",".join(f"{v:.6f}" for v in r) + f",{lab}\n")
+
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+# logreg 8 features x 2 classes -> 27 params = 108 bytes.  Page 2
+# params (8 bytes): hot 8 = 1 page (~1/13 of the model, under the 1/10
+# acceptance cap), warm 16 = 2 pages, the remaining 11 pages cold.
+TIER = ["--tier-hot-bytes", "8", "--tier-warm-bytes", "16",
+        "--tier-page-params", "2"]
+
+def run_arm(tag, consistency, max_it, tier, eval_every=1, extra=()):
+    cwd = os.path.join(root, tag)
+    os.makedirs(cwd, exist_ok=True)
+    ckpt = os.path.join(cwd, "ckpt.npz")
+    cmd = [sys.executable, "-m", "kafka_ps_tpu.cli.run",
+           "-training", train, "-test", test, "--num_workers", "2",
+           "--num_features", "8", "--num_classes", "2", "-min", "8",
+           "-max", "32", "-p", "1", "-c", str(consistency),
+           "--mode", "serial", "--eval_every", str(eval_every),
+           "--max_iterations", str(max_it), "--logging",
+           "--checkpoint", ckpt, "--checkpoint_every", "20"]
+    if tier:
+        cmd += [*TIER, "--durable-log", os.path.join(cwd, "log")]
+    proc = subprocess.Popen([*cmd, *extra], env=env, cwd=cwd, text=True,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE)
+    return proc, cwd, ckpt
+
+def finish(proc, tag):
+    rc = proc.wait(timeout=240)
+    err = proc.stderr.read()
+    assert rc == 0, f"{tag} rc={rc}\n{err[-4000:]}"
+
+def csv_rows(cwd):
+    # column 0 is the wall-clock timestamp — the only legal difference
+    with open(os.path.join(cwd, "logs-server.csv")) as fh:
+        return [";".join(ln.split(";")[1:]) for ln in fh.read().splitlines()]
+
+# -- phase A: capped vs resident, all three consistency models ------------
+MAX_IT = 80
+for c in (0, 2, -1):
+    pb, db, kb = run_arm(f"base-{c}", c, MAX_IT, tier=False)
+    finish(pb, f"base-{c}")
+    pt, dt, kt = run_arm(f"capped-{c}", c, MAX_IT, tier=True)
+    finish(pt, f"capped-{c}")
+    zb, zt = np.load(kb), np.load(kt)
+    assert int(zt["iterations"]) >= MAX_IT <= int(zb["iterations"])
+    tier_res = np.asarray(zt["tier_residency"])
+    from kafka_ps_tpu.store import TIER_COLD
+    assert (tier_res == TIER_COLD).sum() >= 8, \
+        f"c={c}: capped arm was not actually tiered: {tier_res}"
+    assert zt["theta"].tobytes() == zb["theta"].tobytes(), \
+        f"c={c}: capped theta diverged from resident theta"
+    assert csv_rows(dt) == csv_rows(db) != [], \
+        f"c={c}: eval CSV diverged between capped and resident"
+
+# -- phase B: SIGKILL the capped durable run, restart, resident replay ----
+from kafka_ps_tpu.log import LogConfig
+from kafka_ps_tpu.log.manager import LogManager
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime import serde
+from kafka_ps_tpu.runtime.server import ServerNode
+from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig, PSConfig,
+                                       StreamConfig)
+
+KILL_IT = 200
+for c in (0, 2, -1):
+    tag = f"crash-{c}"
+    proc, cwd, ckpt = run_arm(tag, c, KILL_IT, tier=True,
+                              eval_every=1000000)
+    logdir = os.path.join(cwd, "log")
+    grad_glob = os.path.join(logdir, "gradients", "*", "*.log")
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        segs = glob.glob(grad_glob)
+        if (segs and sum(os.path.getsize(s) for s in segs) > 6000
+                and os.path.exists(ckpt)):
+            break
+        if proc.poll() is not None:
+            print(proc.stderr.read(), file=sys.stderr)
+            raise SystemExit(f"{tag} exited before the kill point")
+        time.sleep(0.05)
+    else:
+        raise SystemExit(f"{tag} gradient log never grew")
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+    proc2, _, _ = run_arm(tag, c, KILL_IT, tier=True, eval_every=1000000)
+    finish(proc2, f"{tag}-restarted")
+
+    z = np.load(ckpt)
+    from kafka_ps_tpu.store import TIER_COLD
+    assert (np.asarray(z["tier_residency"]) == TIER_COLD).any(), \
+        f"{tag}: final checkpoint recorded no cold pages"
+    cold_segs = glob.glob(os.path.join(logdir, "param-cold", "*.log"))
+    assert cold_segs and sum(os.path.getsize(s) for s in cold_segs) > 0, \
+        f"{tag}: cold partition is empty — nothing was ever demoted"
+    # resident replay: the gradients partition (offset 0 up to the
+    # final checkpoint's committed offset) through a fresh UNTIERED
+    # ServerNode — log order is processing order across both
+    # incarnations and the tracker dedups redelivered slices, so a
+    # bitwise match proves capped+crash+restart == fully resident
+    end = json.loads(str(z["log_offsets"]))["gradients/0"]
+    cfg = PSConfig(num_workers=2, consistency_model=c, task="logreg",
+                   model=ModelConfig(num_features=8, num_classes=2),
+                   buffer=BufferConfig(min_size=8, max_size=32),
+                   stream=StreamConfig(time_per_event_ms=1),
+                   use_gang=False)
+    srv = ServerNode(cfg, fabric_mod.Fabric(), None, None, None)
+    srv.start_training_loop()
+    mgr = LogManager(logdir, LogConfig())
+    n = 0
+    for off, payload in mgr.get("gradients", 0).read_from(0):
+        if off >= end:
+            break
+        srv.process(serde.from_bytes(payload))
+        n += 1
+    mgr.close()
+    assert srv.iterations >= KILL_IT, (c, srv.iterations)
+    replay = np.asarray(srv.theta, dtype=np.float32)
+    assert replay.tobytes() == z["theta"].tobytes(), \
+        f"{tag}: resident replay diverged from recovered capped theta"
+
+print(f"TIER_SMOKE_OK models=0/2/-1 hot=8B/108B pages=1hot+2warm+11cold "
+      f"phaseA_iters={MAX_IT} phaseB_iters={KILL_IT} "
+      f"theta=bitwise csv=bitwise crash=recovered-bitwise")
 EOF
     exit $?
 fi

@@ -1,0 +1,8 @@
+"""PS101 positive fixture (store/ path): a per-page apply jit built
+inside the pin path — recompiled on every fault."""
+import jax
+
+
+def apply_to_page(page_value, delta):
+    fn = jax.jit(lambda t, d: t + d)
+    return fn(page_value, delta)

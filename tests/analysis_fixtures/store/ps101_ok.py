@@ -1,0 +1,12 @@
+"""PS101 negative fixture (store/ path): the page apply jit lives at
+module level; bucketed shapes come from a keyed cache."""
+import functools
+
+import jax
+
+apply_page = jax.jit(lambda t, d: t + d)     # module level
+
+
+@functools.lru_cache(maxsize=None)
+def bucketed_apply(bucket):
+    return jax.jit(lambda t, d: t + d)       # keyed-cache site

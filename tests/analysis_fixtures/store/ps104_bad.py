@@ -1,0 +1,8 @@
+"""PS104 positive fixture (store/ path): a randomized eviction victim —
+the promotion/demotion plan must be a pure function of heat counters,
+or capped replays diverge from the recorded residency."""
+import random
+
+
+def pick_demotion_victim(pages):
+    return random.choice(pages)

@@ -1,0 +1,12 @@
+"""PS104 negative fixture (store/ path): deterministic plan — coldest
+page first, index as the tiebreak; monotonic pacing for the policy
+thread is replay-safe (it never reaches parameter values)."""
+import time
+
+
+def plan(pages):
+    return sorted(pages, key=lambda p: (-p.heat, p.index))
+
+
+def rebalance_due(last, interval):
+    return time.monotonic() - last >= interval

@@ -1,0 +1,13 @@
+"""PS105 positive fixture (store/ path): cold-log fsync while holding
+the residency lock — every pin on every other page stalls behind the
+disk."""
+import os
+import threading
+
+_residency_lock = threading.Lock()
+
+
+def demote(fd, page):
+    with _residency_lock:
+        page.tier = 2
+        os.fsync(fd)

@@ -1,0 +1,16 @@
+"""PS105 negative fixture (store/ path): the residency lock covers only
+the tier flip; the cold-log write happens outside, and the move commits
+only if the page version is unchanged."""
+import os
+import threading
+
+_residency_lock = threading.Lock()
+
+
+def demote(fd, page):
+    with _residency_lock:
+        value, version = page.value, page.version
+    os.fsync(fd)                 # blocking I/O outside the lock
+    with _residency_lock:
+        if page.version == version:
+            page.tier = 2

@@ -32,6 +32,9 @@ def _findings(relpath: str):
     ("ps104_sharding_bad/runtime/sharding.py", "PS104"),
     ("ps104_sharding_bad/parallel/range_sharded.py", "PS104"),
     ("ps105_bad.py", "PS105"),
+    ("store/ps101_bad.py", "PS101"),
+    ("store/ps104_bad.py", "PS104"),
+    ("store/ps105_bad.py", "PS105"),
     ("serving/ps102_bad.py", "PS102"),
     ("serving/ps105_bad.py", "PS105"),
     ("serving/costmodel_ps102_bad.py", "PS102"),
@@ -54,6 +57,9 @@ def test_positive_fixture_triggers_exactly_once(relpath, rule):
     "ps104_sharding_ok/runtime/sharding.py",
     "ps104_sharding_ok/parallel/range_sharded.py",
     "ps105_ok.py",
+    "store/ps101_ok.py",
+    "store/ps104_ok.py",
+    "store/ps105_ok.py",
     "serving/ps102_ok.py",
     "serving/ps105_ok.py",
     "serving/costmodel_ps102_ok.py",

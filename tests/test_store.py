@@ -1,0 +1,300 @@
+"""Tiered parameter store (kafka_ps_tpu/store/, docs/TIERING.md):
+residency mechanics, the bitwise contract under concurrent
+promote/demote, and checkpoint restore with cold-referenced ranges."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.analysis import lockgraph
+from kafka_ps_tpu.runtime.app import StreamingPSApp
+from kafka_ps_tpu.runtime.messages import KeyRange
+from kafka_ps_tpu.store import (TIER_COLD, TIER_HOT, TIER_WARM, ColdStore,
+                                TieredParamStore)
+from kafka_ps_tpu.utils.config import (BufferConfig, EVENTUAL, ModelConfig,
+                                       PSConfig, StreamConfig, TierConfig)
+
+PAGE = 4          # params per page in these tests
+NPAGES = 8
+
+
+def _values(n=PAGE * NPAGES, seed=7):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=n).astype(np.float32)
+
+
+def _store(tmp_path, hot_pages=2, warm_pages=2, values=None, cold=True,
+           **kw):
+    vals = _values() if values is None else values
+    c = ColdStore.open(str(tmp_path / "param-cold")) if cold else None
+    return TieredParamStore(
+        vals, KeyRange(0, len(vals)),
+        hot_bytes=hot_pages * PAGE * 4, warm_bytes=warm_pages * PAGE * 4,
+        page_params=PAGE, cold=c, **kw), vals
+
+
+# -- geometry and residency ------------------------------------------------
+
+def test_page_geometry():
+    vals = _values(PAGE * 3 + 2)     # last page is a stub
+    s = TieredParamStore(vals, KeyRange(0, len(vals)), page_params=PAGE)
+    assert s.num_pages == 4
+    assert s.page_range(3) == KeyRange(12, 14)
+    assert list(s.pages_overlapping(KeyRange(3, 9))) == [0, 1, 2]
+    assert list(s.pages_overlapping(KeyRange(4, 5))) == [1]
+    assert list(s.pages_overlapping(KeyRange(99, 120))) == []
+    s.close()
+
+
+def test_unbounded_default_is_fully_hot():
+    vals = _values()
+    s = TieredParamStore(vals, KeyRange(0, len(vals)), page_params=PAGE)
+    assert s.tier_counts() == {"hot": NPAGES, "warm": 0, "cold": 0}
+    assert np.asarray(s.assembled()).tobytes() == vals.tobytes()
+    s.close()
+
+
+def test_budgets_settle_initial_residency(tmp_path):
+    s, vals = _store(tmp_path, hot_pages=2, warm_pages=3)
+    counts = s.tier_counts()
+    assert counts == {"hot": 2, "warm": 3, "cold": 3}
+    rb = s.resident_bytes()
+    assert rb["resident"] == 5 * PAGE * 4
+    assert rb["cold_logged"] == 3 * PAGE * 4
+    # residency never changes values
+    assert s.assembled().tobytes() == vals.tobytes()
+    s.close()
+
+
+def test_warm_cap_requires_cold_store():
+    vals = _values()
+    with pytest.raises(ValueError, match="cold store"):
+        TieredParamStore(vals, KeyRange(0, len(vals)),
+                         warm_bytes=PAGE * 4, page_params=PAGE)
+
+
+def test_pin_faults_cold_page_warm(tmp_path):
+    s, vals = _store(tmp_path, hot_pages=1, warm_pages=1)
+    cold_pages = [i for i in range(NPAGES)
+                  if s.residency_vector()[i] == TIER_COLD]
+    i = cold_pages[0]
+    kr = s.page_range(i)
+    got = s.pin(kr)
+    assert got.tobytes() == vals[kr.start:kr.end].tobytes()
+    assert s.faults == 1
+    assert s.residency_vector()[i] == TIER_WARM   # installed warm
+    assert s.pins["cold"] == 1
+    s.close()
+
+
+def test_heat_drives_promotion(tmp_path):
+    s, _ = _store(tmp_path, hot_pages=1, warm_pages=2)
+    victim = int(np.flatnonzero(s.residency_vector() == TIER_COLD)[-1])
+    for _ in range(32):
+        s.pin(s.page_range(victim))
+    s.rebalance()
+    assert s.residency_vector()[victim] == TIER_HOT
+    # exactly one page fits the hot budget, so the old hot page moved out
+    assert s.tier_counts()["hot"] == 1
+    s.close()
+
+
+def test_update_page_on_cold_page_lands_warm(tmp_path):
+    s, vals = _store(tmp_path, hot_pages=1, warm_pages=1)
+    i = int(np.flatnonzero(s.residency_vector() == TIER_COLD)[0])
+    kr = s.page_range(i)
+    new = np.arange(kr.end - kr.start, dtype=np.float32)
+    s.update_page(i, new)
+    assert s.residency_vector()[i] == TIER_WARM
+    assert s.pin(kr, count_heat=False).tobytes() == new.tobytes()
+    s.close()
+
+
+def test_replace_all_roundtrip(tmp_path):
+    s, _ = _store(tmp_path, hot_pages=2, warm_pages=2)
+    new = np.arange(PAGE * NPAGES, dtype=np.float32)
+    s.replace_all(new)
+    assert s.assembled().tobytes() == new.tobytes()
+    # cold pages landed warm; a rebalance re-demotes within budgets
+    s.rebalance()
+    assert s.tier_counts()["cold"] > 0
+    assert s.assembled().tobytes() == new.tobytes()
+    s.close()
+
+
+# -- the cold store --------------------------------------------------------
+
+def test_cold_store_roundtrip_and_header_check(tmp_path):
+    c = ColdStore.open(str(tmp_path / "cold"))
+    vals = _values(PAGE)
+    off = c.put(3, 12, 16, vals)
+    assert c.get(off, 3, 12, 16).tobytes() == vals.tobytes()
+    with pytest.raises(KeyError, match="wanted page 4"):
+        c.get(off, 4, 16, 20)
+    c.close()
+
+
+# -- races: concurrent promote/demote vs apply and snapshot reads ----------
+
+def test_snapshot_reads_race_migrations(tmp_path):
+    """Heat-driven migrations churn under concurrent full-slice reads:
+    residency must never change values, and the migrated locks must
+    order cleanly (no lockgraph cycle)."""
+    with lockgraph.isolated() as g:
+        s, vals = _store(tmp_path, hot_pages=2, warm_pages=2,
+                         rebalance_interval_s=0.001)
+        s.start_policy_thread()
+        errors = []
+
+        def reader():
+            for _ in range(120):
+                if s.assembled().tobytes() != vals.tobytes():
+                    errors.append("assembled drifted")
+                    return
+
+        def pinner(phase):
+            # shift heat between page groups so the policy keeps moving
+            for k in range(120):
+                i = (k + phase) % NPAGES
+                s.pin(s.page_range(i))
+
+        ts = [threading.Thread(target=f) for f in
+              (reader, reader, lambda: pinner(0), lambda: pinner(4))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        s.close()
+        assert errors == []
+        assert g.cycles() == []
+    assert s.promotions + s.demotions > 0   # the race actually happened
+
+
+def test_concurrent_apply_vs_policy_thread_is_exact(tmp_path):
+    """Writes race promote/demote: the version-checked commit must let
+    every write win — after N +1.0 applies per page the assembled slice
+    is exactly initial + N (f32 integer math, no tolerance)."""
+    with lockgraph.isolated() as g:
+        init = np.zeros(PAGE * NPAGES, dtype=np.float32)
+        s, _ = _store(tmp_path, hot_pages=2, warm_pages=2, values=init,
+                      rebalance_interval_s=0.001)
+        s.start_policy_thread()
+        rounds = 60
+
+        def writer():
+            for _ in range(rounds):
+                for i in range(NPAGES):
+                    (_, _, value), = s.pin_pages(s.page_range(i))
+                    host = np.asarray(value, dtype=np.float32)
+                    s.update_page(i, host + np.float32(1.0))
+
+        def reader():
+            for _ in range(100):
+                got = s.assembled()
+                assert got.shape == init.shape
+
+        ts = [threading.Thread(target=writer),
+              threading.Thread(target=reader)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # assemble BEFORE close (close drops the cold log; the CLIs
+        # save their final checkpoint before close_tiering for the
+        # same reason)
+        expect = np.full_like(init, float(rounds))
+        assert s.assembled().tobytes() == expect.tobytes()
+        s.close()
+        assert g.cycles() == []
+
+
+# -- checkpoint restore with a cold-referenced range -----------------------
+
+def test_residency_restore_rereads_cold_range(tmp_path):
+    """Restore re-applies recorded residency by RE-demoting cold pages
+    (fresh appends — the checkpoint is self-contained), then a pin of a
+    recorded-cold range must reproduce the exact bytes."""
+    s, vals = _store(tmp_path, hot_pages=2, warm_pages=2)
+    for _ in range(8):
+        s.pin(s.page_range(0))            # make heat non-uniform
+    s.rebalance()
+    # residency first, then theta — assembling faults cold pages warm
+    # (the same order utils/checkpoint.save uses)
+    tiers = s.residency_vector()
+    reads, writes = s.heat_vectors()
+    theta = s.assembled()
+    assert (tiers == TIER_COLD).any()
+    s.close()
+
+    # restart: same cold directory, fresh store seeded with zeros, then
+    # the checkpoint-restore sequence (replace_all -> set_residency)
+    c2 = ColdStore.open(str(tmp_path / "param-cold"))
+    s2 = TieredParamStore(np.zeros_like(vals), KeyRange(0, len(vals)),
+                          hot_bytes=2 * PAGE * 4, warm_bytes=2 * PAGE * 4,
+                          page_params=PAGE, cold=c2)
+    s2.replace_all(theta)
+    s2.set_residency(tiers, reads, writes)
+    assert np.array_equal(s2.residency_vector(), tiers)
+    cold_page = int(np.flatnonzero(tiers == TIER_COLD)[0])
+    kr = s2.page_range(cold_page)
+    assert s2.pin(kr).tobytes() == vals[kr.start:kr.end].tobytes()
+    assert s2.assembled().tobytes() == theta.tobytes()
+    s2.close()
+
+
+def test_set_residency_rejects_page_count_mismatch(tmp_path):
+    s, _ = _store(tmp_path)
+    with pytest.raises(ValueError, match="page_params changed"):
+        s.set_residency(np.zeros(NPAGES + 1, dtype=np.int8))
+    s.close()
+
+
+# -- end to end: capped run is bitwise-equal to fully resident -------------
+
+def _tiny_cfg(consistency, tier=None):
+    return PSConfig(
+        num_workers=2,
+        consistency_model=consistency,
+        model=ModelConfig(num_features=8, num_classes=2),
+        buffer=BufferConfig(min_size=8, max_size=32),
+        stream=StreamConfig(time_per_event_ms=1.0),
+        tier=tier or TierConfig(),
+    )
+
+
+def _dataset(n=128, f=8, seed=3):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(1, 3, size=n).astype(np.int32)
+    centers = np.array([[0.0] * f, [2.0] * f, [-2.0] * f], np.float32)
+    x = (centers[y] + rng.normal(scale=0.5, size=(n, f))).astype(np.float32)
+    return x, y
+
+
+def _run(consistency, tmp_path=None, tier=None):
+    cfg = _tiny_cfg(consistency, tier)
+    x, y = _dataset()
+    app = StreamingPSApp(cfg, test_x=x, test_y=y)
+    if tier is not None:
+        cold = str(tmp_path / f"cold-{consistency}")
+        app.enable_tiering(cold if tier.warm_bytes else None)
+        assert app.server.param_store is not None
+    for i in range(len(x)):
+        w = i % cfg.num_workers
+        app.data_sink(w, {j: float(v) for j, v in enumerate(x[i])
+                          if v != 0}, int(y[i]))
+    app.run_serial(max_server_iterations=20)
+    theta = np.asarray(app.server.theta).copy()
+    app.close_tiering()
+    return theta
+
+
+@pytest.mark.parametrize("consistency", [0, 2, EVENTUAL])
+def test_capped_run_bitwise_equals_resident(tmp_path, consistency):
+    # num_params = 3*8+3 = 27; page 2 params -> 14 pages; hot 2 pages,
+    # warm 3 pages -> most of theta lives cold
+    tier = TierConfig(hot_bytes=2 * 2 * 4, warm_bytes=3 * 2 * 4,
+                      page_params=2, rebalance_interval_s=0.002)
+    base = _run(consistency)
+    capped = _run(consistency, tmp_path, tier)
+    assert capped.tobytes() == base.tobytes()
