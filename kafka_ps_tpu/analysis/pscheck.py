@@ -24,18 +24,26 @@ time; these rules catch the regressions at commit time instead:
          per-shard durable-log recovery is bitwise only if routing and
          assembly order depend on (shard, worker, clock) alone; the
          tiered store because its promotion/demotion plan must be a
-         pure function of heat counters (docs/TIERING.md).
+         pure function of heat counters (docs/TIERING.md).  The derived
+         observability modules (``telemetry/critpath.py``,
+         ``profiler.py``, ``slo.py``) are held to the same rule: their
+         verdicts must be pure functions of recorded timestamps and
+         registry snapshots, never of a wall clock read at analysis
+         time — the profiler's display-only wall anchor is the one
+         reasoned suppression.
   PS105  blocking I/O (socket send/recv, frame send/recv, ``fsync``,
          ``time.sleep``) while holding a lock.
   PS106  host-sync calls (``.item()``, ``float()``, ``np.asarray``,
          ``np.array``, ``.block_until_ready()``) inside the ARGUMENTS
          of a telemetry/trace call (``span``, ``count``, ``observe``,
          ``inc``, ``flow_*``) or a flight-recorder call (``record``,
-         telemetry/flight.py) in ``runtime/``, ``ops/`` or
-         ``serving/`` — instrumentation must observe host scalars
-         only; a metric that syncs the device perturbs the very
-         latency it measures and breaks the telemetry-off/on bitwise
-         contract (docs/OBSERVABILITY.md).
+         telemetry/flight.py) in ``runtime/``, ``ops/``, ``serving/``
+         or the derived observability modules
+         (``telemetry/critpath.py``, ``profiler.py``, ``slo.py``) —
+         instrumentation must observe host scalars only; a metric that
+         syncs the device perturbs the very latency it measures and
+         breaks the telemetry-off/on bitwise contract
+         (docs/OBSERVABILITY.md).
 
 Suppression syntax, on the finding line or the line directly above::
 
@@ -69,10 +77,12 @@ RULES: dict[str, str] = {
     "PS103": "re-encoding in serde.py/net.py of messages that carry "
              "verbatim encoded parts",
     "PS104": "nondeterminism in a replay-critical module "
-             "(log/, compress/, store/, runtime/serde.py)",
+             "(log/, compress/, store/, runtime/serde.py, the derived "
+             "observability modules in telemetry/)",
     "PS105": "blocking I/O while holding a lock",
     "PS106": "host-sync call inside the arguments of a telemetry/trace "
-             "or flight-recorder call in runtime/, ops/ or serving/",
+             "or flight-recorder call in runtime/, ops/, serving/ or "
+             "the derived observability modules in telemetry/",
 }
 
 # -- rule scoping ----------------------------------------------------------
@@ -549,6 +559,13 @@ def _rules_for(path: Path) -> set:
             or (path.name == "sharding.py" and "runtime" in parts)
             or (path.name == "range_sharded.py" and "parallel" in parts)):
         rules.add("PS104")
+    if "telemetry" in parts and path.name in ("critpath.py",
+                                              "profiler.py", "slo.py"):
+        # derived observability: analysis verdicts must be pure
+        # functions of recorded data (PS104), and nothing on these
+        # paths may host-sync inside an instrumentation call (PS106)
+        rules.add("PS104")
+        rules.add("PS106")
     return rules
 
 

@@ -126,7 +126,33 @@ def build_parser(include_server_flags: bool = True,
                         "/healthz watchdog-derived liveness/readiness "
                         "(the k8s probe target, deploy/k8s/*.yaml), "
                         "/varz Prometheus metrics snapshot, /flightz "
-                        "recent flight-ring tail")
+                        "recent flight-ring tail, /profilez collapsed "
+                        "stacks when --profile is armed")
+    p.add_argument("--profile", action="store_true",
+                   help="arm the continuous sampling profiler "
+                        "(telemetry/profiler.py, ~100 Hz stdlib stack "
+                        "sampler, docs/OBSERVABILITY.md): collapsed-"
+                        "stack text on /profilez (--health-port) and "
+                        "the hottest stacks in every flight dump, so a "
+                        "watchdog trip ships its own profile; <2%% "
+                        "overhead asserted by the profiling_overhead "
+                        "bench block")
+    p.add_argument("--slo-serving-p99-ms", dest="slo_serving_p99_ms",
+                   type=float, default=None, metavar="MS",
+                   help="arm the SLO plane (telemetry/slo.py) with a "
+                        "serving-latency objective: 99%% of requests "
+                        "answered within MS.  Burn rates over 5min/1h "
+                        "windows export as slo_burn_rate gauges, ride "
+                        "/healthz, and a sustained fast-window burn "
+                        "trips a flight dump (serving availability is "
+                        "always tracked once any --slo-* flag is set)")
+    p.add_argument("--slo-freshness-ms", dest="slo_freshness_ms",
+                   type=float, default=None, metavar="MS",
+                   help="arm the SLO plane with a snapshot-freshness "
+                        "objective: 99%% of served reads see a snapshot "
+                        "younger than MS (snapshot_age_ms histogram; "
+                        "same burn-rate windows and watchdog as "
+                        "--slo-serving-p99-ms)")
     p.add_argument("--device_trace", default=None, metavar="LOGDIR",
                    help="capture a jax.profiler device trace (TensorBoard "
                         "logdir) for the whole run")
@@ -352,7 +378,10 @@ def make_app_from_args(args, resuming: bool = False,
     telemetry = maybe_telemetry(
         tracer,
         want_metrics=bool(getattr(args, "metrics_file", None))
-        or getattr(args, "health_port", None) is not None)
+        or getattr(args, "health_port", None) is not None
+        # the SLO plane judges registry families, so arming it arms them
+        or getattr(args, "slo_serving_p99_ms", None) is not None
+        or getattr(args, "slo_freshness_ms", None) is not None)
     fabric = None
     if getattr(args, "durable_log", None):
         from kafka_ps_tpu.log import DurableFabric, LogConfig
@@ -608,9 +637,12 @@ def run_with_args(args) -> int:
     # flight recorder + watchdogs + health plane (docs/OBSERVABILITY.md)
     # — wired unconditionally; inert unless --flight-dir/--health-port
     from kafka_ps_tpu.telemetry.health import OpsPlane
+    from kafka_ps_tpu.telemetry.slo import plane_from_args
     ops = OpsPlane(flight_dir=getattr(args, "flight_dir", None),
                    health_port=getattr(args, "health_port", None),
-                   telemetry=app.telemetry, role="run")
+                   telemetry=app.telemetry, role="run",
+                   profile=getattr(args, "profile", False),
+                   slo_plane=plane_from_args(args, app.telemetry))
     ops.add_gate_watchdog(app.server)
     if getattr(args, "durable_log", None):
         ops.add_fsync_watchdog()

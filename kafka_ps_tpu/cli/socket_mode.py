@@ -127,7 +127,10 @@ def _make_telemetry(args):
     telemetry = maybe_telemetry(
         tracer,
         want_metrics=bool(getattr(args, "metrics_file", None))
-        or getattr(args, "health_port", None) is not None)
+        or getattr(args, "health_port", None) is not None
+        # the SLO plane judges registry families, so arming it arms them
+        or getattr(args, "slo_serving_p99_ms", None) is not None
+        or getattr(args, "slo_freshness_ms", None) is not None)
     if getattr(args, "metrics_file", None) \
             and getattr(args, "metrics_every", 0.0) > 0:
         telemetry.start_dumper(args.metrics_file, args.metrics_every)
@@ -142,10 +145,13 @@ def _make_ops(args, telemetry, *, role, shard=None, meta=None):
     SIGABRT/fatal signals — the raw material of `python -m
     kafka_ps_tpu.telemetry postmortem`."""
     from kafka_ps_tpu.telemetry.health import OpsPlane
+    from kafka_ps_tpu.telemetry.slo import plane_from_args
     return OpsPlane(flight_dir=getattr(args, "flight_dir", None),
                     health_port=getattr(args, "health_port", None),
                     telemetry=telemetry, role=role, shard=shard,
-                    meta=meta)
+                    meta=meta,
+                    profile=getattr(args, "profile", False),
+                    slo_plane=plane_from_args(args, telemetry))
 
 
 def _dump_telemetry(args, tracer, telemetry) -> None:
@@ -427,6 +433,11 @@ def run_server(args) -> int:
     # depth — the split-mode face of `--status_every`
     from kafka_ps_tpu.utils.status import StatusReporter
 
+    rolling_critpath = None
+    if telemetry.enabled:
+        from kafka_ps_tpu.telemetry.critpath import RollingCritpath
+        rolling_critpath = RollingCritpath(telemetry)
+
     def status() -> dict:
         tr = server.tracker
         active = tr.active_workers
@@ -447,6 +458,10 @@ def run_server(args) -> int:
                               "stale": s["rejections"]}
         if telemetry.enabled:
             out["metrics"] = telemetry.summary()
+        if rolling_critpath is not None:
+            # per-heartbeat histogram deltas -> dominant-segment verdict
+            # for this window (telemetry/critpath.py)
+            out["critpath"] = rolling_critpath.sample()
         return out
 
     reporter = StatusReporter(getattr(args, "status_every", 0.0) or 0.0,

@@ -108,6 +108,9 @@ class StreamingPSApp:
         # online serving plane (kafka_ps_tpu/serving/): built on demand
         # by enable_serving(); None keeps the app purely a trainer
         self.serving_engine = None
+        # rolling critical-path sampler, built lazily on first status()
+        # heartbeat with telemetry on (telemetry/critpath.py)
+        self._critpath = None
         # Multi-host: the subset of logical workers this process hosts
         # (None = all).  Every host streams the same CSV with the same
         # global round-robin, keeping only its own workers' rows — the
@@ -328,6 +331,12 @@ class StreamingPSApp:
             # flattened registry heartbeat (counter totals + histogram
             # p50/n) rides the same [status] line as the runtime pulse
             out["metrics"] = self.telemetry.summary()
+            # rolling critical path: per-heartbeat histogram deltas name
+            # the segment dominating *this* window (telemetry/critpath)
+            if self._critpath is None:
+                from kafka_ps_tpu.telemetry.critpath import RollingCritpath
+                self._critpath = RollingCritpath(self.telemetry)
+            out["critpath"] = self._critpath.sample()
         return out
 
     def _start_status(self, status_every: float | None):

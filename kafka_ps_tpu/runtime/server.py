@@ -83,6 +83,7 @@ class ServerNode:
         # servers label every family with their shard id; the unsharded
         # server keeps the historical label set.
         model = model_name(cfg.consistency_model)
+        self._model = model          # span/critpath label, stable per node
         shard_labels = ({"shard": str(shard_id)} if num_shards > 1 else {})
         self._m_gate_wait = self.telemetry.histogram(
             "gate_wait_ms", model=model, **shard_labels)
@@ -101,9 +102,12 @@ class ServerNode:
             "snapshots_published_total", **shard_labels)
         self._m_serving_clock = self.telemetry.gauge("serving_clock",
                                                      **shard_labels)
-        # perf_counter stamp of each worker's last un-answered gradient:
-        # gate wait = release time - arrival time (host scalars only)
-        self._grad_arrived: dict[int, float] = {}
+        # (perf_counter stamp, clock) of each worker's last un-answered
+        # gradient: gate wait = release time - arrival time (host
+        # scalars only); the clock rides along so the retroactive
+        # gate.wait trace span can be matched to its delta flow
+        # (telemetry/critpath.py keys segments on (worker, clock))
+        self._grad_arrived: dict[int, tuple[float, int]] = {}
         # trace context of the gradient currently being processed — the
         # snapshot published by its release inherits it, extending the
         # delta.wire flow into the serving plane
@@ -333,12 +337,24 @@ class ServerNode:
         gate before its reply went out (BSP waits for the round, bounded
         delay waits for the slowest-within-k, eventual ~0).  Bootstrap
         and readmission sends have no arrival stamp and record
-        nothing."""
+        nothing.
+
+        Also emits the retroactive `gate.wait` trace span — the gate
+        holds weights RELEASES, not applies (gradients apply on
+        arrival), so the hold time only exists as a span once the
+        release happens.  The tracer's default clock is the same
+        perf_counter the arrival stamp used, so span_at gets two values
+        on one epoch."""
         if not self.telemetry.enabled:
             return
-        arrived = self._grad_arrived.pop(worker, None)
-        if arrived is not None:
-            self._m_gate_wait.observe((time.perf_counter() - arrived) * 1e3)
+        entry = self._grad_arrived.pop(worker, None)
+        if entry is not None:
+            arrived, clock = entry
+            now = time.perf_counter()
+            self._m_gate_wait.observe((now - arrived) * 1e3)
+            self.tracer.span_at("gate.wait", arrived, now, worker=worker,
+                                clock=clock, model=self._model,
+                                shard=self.shard_id)
 
     def gate_waiting(self) -> int:
         """How many active workers are currently parked at the gate
@@ -469,6 +485,12 @@ class ServerNode:
         clock = self.serving_clock() if clock is None else clock
         registry.publish(self.theta if theta is None else theta,
                          clock, trace=trace)
+        if trace is not None:
+            # the flow's publish step: critpath reads the snapshot-
+            # publish moment off this event (the segment between apply
+            # and the first serving read, telemetry/critpath.py)
+            self.tracer.flow_step("delta.wire", trace, step="publish",
+                                  clock=int(clock))
         self.tracer.count("serving.snapshots_published")
         if self.telemetry.enabled:
             self._m_snapshots.inc()
@@ -508,7 +530,7 @@ class ServerNode:
         m = None
         with self.tracer.span("server.apply", worker=msg.worker_id,
                               clock=msg.vector_clock,
-                              shard=self.shard_id):
+                              shard=self.shard_id, model=self._model):
             r = msg.key_range
             if getattr(msg, "indices", None) is not None:
                 # sparse delta slice (SparseDeltaMessage, range sharding):
@@ -701,7 +723,7 @@ class ServerNode:
         """Per-gradient consistency observations, all host integers:
         arrival stamp (gate-wait baseline), this worker's clock lag
         behind the fastest active worker, and the applied-count."""
-        self._grad_arrived[worker] = time.perf_counter()
+        self._grad_arrived[worker] = (time.perf_counter(), clock)
         self._m_grads[worker].inc()
         active = self.tracker.active_workers
         if active:
@@ -813,7 +835,8 @@ class ServerNode:
         # same span name as the per-message path — one entry now covers
         # k chained applies (the `gang` arg distinguishes the two)
         with self.tracer.span("server.apply", gang=k,
-                              workers=[m.worker_id for m in live]):
+                              workers=[m.worker_id for m in live],
+                              model=self._model):
             final_theta, prefixes, metrics = fn(
                 jnp.asarray(self.theta), self.test_x, self.test_y,
                 *[m.values for m in live])

@@ -160,6 +160,11 @@ class PredictionEngine:
         self._m_queue_depth = self.telemetry.gauge("serving_queue_depth")
         self._m_sheds = self.telemetry.counter("serving_shed_total")
         self._m_batch_size = self.telemetry.histogram("serving_batch_size")
+        # per-request wall latency as a bucketed histogram: the serving-
+        # latency SLO (telemetry/slo.py) and the rolling critical path
+        # need windowed bucket deltas, which the sliding-window
+        # LatencyRecorder cannot provide
+        self._m_latency = self.telemetry.histogram("serving_latency_ms")
         # dispatch-mode counter family: how often each dispatch path
         # won (the shm transport increments its own child in net.py)
         self._m_mode = {
@@ -579,7 +584,10 @@ class PredictionEngine:
             b <<= 1
 
     def _finish(self, req: _Request, result) -> None:
-        self.latency.record(time.monotonic() - req.t0)
+        elapsed = time.monotonic() - req.t0
+        self.latency.record(elapsed)
+        if self.telemetry.enabled:
+            self._m_latency.observe(elapsed * 1e3)
         try:
             req.callback(result)
         except Exception:  # noqa: BLE001 — a bad callback must not stall serving

@@ -50,6 +50,7 @@ import numpy as np
 
 from kafka_ps_tpu.analysis.lockgraph import OrderedLock
 from kafka_ps_tpu.runtime.messages import KeyRange
+from kafka_ps_tpu.telemetry.flight import FLIGHT
 
 TIER_HOT, TIER_WARM, TIER_COLD = 0, 1, 2
 TIER_NAMES = ("hot", "warm", "cold")
@@ -220,6 +221,11 @@ class TieredParamStore:
             if self.telemetry.enabled:
                 self._m_migrations["promote"].inc(len(fetched))
                 self._m_migration_ms["promote"].observe(dt_ms)
+            if FLIGHT.enabled:
+                # demand faults are the tail-latency event a postmortem
+                # wants on the timeline: which pages, how long
+                FLIGHT.record("store.fault", pages=len(fetched),
+                              ms=round(dt_ms, 3))
             for entry in out:
                 if entry[2] is None:
                     entry[2] = by_index[entry[0]]
@@ -407,10 +413,14 @@ class TieredParamStore:
                 else:
                     self.demotions += 1
             dt_ms = (time.perf_counter() - t0) * 1e3
+            d = "promote" if promote else "demote"
             if self.telemetry.enabled:
-                d = "promote" if promote else "demote"
                 self._m_migrations[d].inc()
                 self._m_migration_ms[d].observe(dt_ms)
+            if FLIGHT.enabled:
+                FLIGHT.record(f"store.{d}", page=p.index,
+                              tier=TIER_NAMES[target],
+                              ms=round(dt_ms, 3))
         return applied
 
     # -- the background policy thread ---------------------------------------

@@ -10,9 +10,11 @@ from kafka_ps_tpu.telemetry.registry import (CLOCK_BUCKETS,
                                              NULL_TELEMETRY, Counter,
                                              Gauge, Histogram,
                                              MetricsRegistry, Telemetry,
+                                             interp_quantile,
                                              maybe_telemetry, model_name)
 
 __all__ = ["CLOCK_BUCKETS", "FLIGHT", "FlightRecorder",
            "LATENCY_BUCKETS_MS", "NULL_TELEMETRY",
            "Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "Telemetry", "maybe_telemetry", "model_name"]
+           "Telemetry", "interp_quantile", "maybe_telemetry",
+           "model_name"]

@@ -10,6 +10,12 @@ Subcommands:
       the last (worker, clock) each dead shard acknowledged, watchdog
       trips, gate-stall evidence (docs/OBSERVABILITY.md, "Flight
       recorder & postmortem").
+  critpath TRACE
+      Decompose each delta's end-to-end latency into named segments
+      (buffer wait / local train / wire / apply / gate wait / publish /
+      serving read) and report p50/p99 + the dominant segment per
+      consistency model (docs/OBSERVABILITY.md, "Critical-path
+      analysis").  TRACE is a `merge` output or a single --trace dump.
 """
 
 from __future__ import annotations
@@ -17,6 +23,7 @@ from __future__ import annotations
 import argparse
 import sys
 
+from kafka_ps_tpu.telemetry.critpath import critpath_main
 from kafka_ps_tpu.telemetry.merge import merge_traces
 from kafka_ps_tpu.telemetry.postmortem import main as postmortem_main
 
@@ -35,6 +42,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="analyze a directory of flight dumps and name the culprit")
     post.add_argument("dir", help="directory holding flightdump-*.json "
                                   "(the run's --flight-dir)")
+    crit = sub.add_parser(
+        "critpath",
+        help="per-flow latency decomposition with a dominant-segment "
+             "verdict per consistency model")
+    crit.add_argument("trace", help="merged trace (telemetry merge "
+                                    "output) or a single --trace dump")
     return parser
 
 
@@ -48,6 +61,8 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "postmortem":
         return postmortem_main(args.dir)
+    if args.cmd == "critpath":
+        return critpath_main(args.trace)
     return 2
 
 

@@ -110,6 +110,9 @@ class FlightRecorder:
         self.flight_dir: str | None = None
         self.telemetry = None
         self.panel = None               # WatchdogPanel (health.py), if any
+        self.profiler = None            # SamplingProfiler, if armed — a
+                                        # watchdog trip ships its own
+                                        # profile (telemetry/profiler.py)
         self._wall0 = 0.0
         self._mono0 = 0.0
         self._beats: dict[str, float] = {}
@@ -146,6 +149,7 @@ class FlightRecorder:
         handlers install_death_hooks replaced."""
         self.enabled = False
         self.panel = None
+        self.profiler = None
         self.telemetry = None
         for signum, prev in self._prev_handlers.items():
             try:
@@ -274,6 +278,12 @@ class FlightRecorder:
             except Exception:           # noqa: BLE001 — never lose the box
                 metrics = {"error": "metrics snapshot failed"}
         watchdogs = self.panel.states() if self.panel is not None else {}
+        profile: list[str] = []
+        if self.profiler is not None:
+            try:
+                profile = self.profiler.top_stacks(20)
+            except Exception:           # noqa: BLE001 — never lose the box
+                profile = ["error: profile snapshot failed"]
         return {
             "schema": DUMP_SCHEMA,
             "pid": os.getpid(),
@@ -291,6 +301,7 @@ class FlightRecorder:
             "lockEdges": lock_edges,
             "metrics": metrics,
             "watchdogs": watchdogs,
+            "profile": profile,
         }
 
     # -- dump-on-death ------------------------------------------------------

@@ -23,9 +23,12 @@ conservative, because a false positive here kills a healthy pod:
 
 The HTTP plane is stdlib-only (http.server on a named daemon thread):
 
-    /healthz   200/503 JSON — watchdog-derived liveness/readiness
+    /healthz   200/503 JSON — watchdog-derived liveness/readiness,
+               plus the SLO plane's burn-rate detail when armed
     /varz      Prometheus text exposition (telemetry registry)
     /flightz   recent flight-ring tail as JSON (?n=200)
+    /profilez  collapsed-stack text from the sampling profiler
+               (--profile; 404 when not armed)
 
 `OpsPlane` bundles recorder + panel + server lifecycle for the CLI
 roles (cli/run.py, cli/socket_mode.py): construct, add watchdogs,
@@ -50,6 +53,10 @@ GATE_STALL_S = 30.0
 FSYNC_STALL_S = 15.0
 SERVING_STALL_S = 15.0
 REPLICA_STALL_S = 30.0
+# A fast-window burn over 1.0 must persist this long before the SLO
+# watchdog trips a flight dump — one transiently slow batch is not an
+# incident.
+SLO_BURN_STALL_S = 60.0
 
 
 class Liveness:
@@ -175,10 +182,12 @@ class HealthServer:
     scripts can scrape it, like the serving plane does)."""
 
     def __init__(self, port: int, *, panel: WatchdogPanel | None = None,
-                 flight=None, telemetry=None, host: str = "0.0.0.0"):
+                 flight=None, telemetry=None, slo=None,
+                 host: str = "0.0.0.0"):
         self.panel = panel
         self.flight = flight if flight is not None else FLIGHT
         self.telemetry = telemetry
+        self.slo = slo                  # SLOPlane (telemetry/slo.py)
         plane = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -201,13 +210,16 @@ class HealthServer:
         try:
             if url.path == "/healthz":
                 healthy = self.panel.healthy() if self.panel else True
-                body = json.dumps({
+                detail = {
                     "healthy": healthy,
                     "role": self.flight.role,
                     "shard": self.flight.shard,
                     "watchdogs": (self.panel.states()
                                   if self.panel else {}),
-                }).encode()
+                }
+                if self.slo is not None:
+                    detail["slo"] = self.slo.detail()
+                body = json.dumps(detail).encode()
                 self._send(req, 200 if healthy else 503, body,
                            "application/json")
             elif url.path == "/varz":
@@ -225,6 +237,19 @@ class HealthServer:
                     "events": self.flight.tail(n),
                 }).encode()
                 self._send(req, 200, body, "application/json")
+            elif url.path == "/profilez":
+                prof = getattr(self.flight, "profiler", None)
+                if prof is None:
+                    self._send(req, 404,
+                               b'{"error": "profiler not armed '
+                               b'(--profile)"}',
+                               "application/json")
+                else:
+                    stats = prof.stats()
+                    header = "".join(f"# {k}: {v}\n"
+                                     for k, v in sorted(stats.items()))
+                    text = header + prof.collapsed() + "\n"
+                    self._send(req, 200, text.encode(), "text/plain")
             else:
                 self._send(req, 404, b'{"error": "unknown path"}',
                            "application/json")
@@ -254,11 +279,16 @@ class OpsPlane:
     def __init__(self, *, flight_dir: str | None = None,
                  health_port: int | None = None, telemetry=None,
                  role: str = "run", shard: int | None = None,
-                 meta: dict | None = None, flight=None):
+                 meta: dict | None = None, flight=None,
+                 profile: bool = False, profile_hz: float = 100.0,
+                 slo_plane=None):
         self.flight = flight if flight is not None else FLIGHT
-        self.enabled = flight_dir is not None or health_port is not None
+        self.enabled = (flight_dir is not None or health_port is not None
+                        or profile or slo_plane is not None)
         self.health: HealthServer | None = None
         self.panel: WatchdogPanel | None = None
+        self.profiler = None
+        self.slo = None                 # SLOPlane via add_slo_plane
         self._health_port = health_port
         self._telemetry = telemetry
         if not self.enabled:
@@ -269,6 +299,14 @@ class OpsPlane:
             self.flight.install_death_hooks()
         self.panel = WatchdogPanel(flight=self.flight)
         self.flight.panel = self.panel
+        if profile:
+            # deferred import: the plane must construct without the
+            # profiler module when --profile was not asked for
+            from kafka_ps_tpu.telemetry.profiler import SamplingProfiler
+            self.profiler = SamplingProfiler(hz=profile_hz)
+            self.flight.profiler = self.profiler
+        if slo_plane is not None:
+            self.add_slo_plane(slo_plane)
 
     def add_watchdog(self, name: str, threshold_s: float, *,
                      beat_name: str | None = None,
@@ -305,15 +343,31 @@ class OpsPlane:
         even an empty one, so demand is unconditional)."""
         self.add_watchdog("replica", threshold_s, beat_name="replica")
 
+    def add_slo_plane(self, slo,
+                      threshold_s: float = SLO_BURN_STALL_S) -> None:
+        """Adopt an SLOPlane (telemetry/slo.py): surface it on
+        /healthz, run its sampler from start(), and arm the burn-rate
+        watchdog — the plane beats `slo` while no fast window is
+        burning, so sustained burn is exactly a demand-with-no-progress
+        stall and trips one flight dump."""
+        self.slo = slo
+        self.add_watchdog("slo", threshold_s, beat_name="slo",
+                          demand=slo.burning)
+
     def start(self) -> None:
         if not self.enabled:
             return
+        if self.profiler is not None:
+            self.profiler.start()
+        if self.slo is not None:
+            self.slo.start()
         if self.panel is not None:
             self.panel.start()
         if self._health_port is not None:
             self.health = HealthServer(self._health_port, panel=self.panel,
                                        flight=self.flight,
-                                       telemetry=self._telemetry)
+                                       telemetry=self._telemetry,
+                                       slo=self.slo)
             print(f"health plane on port {self.health.port}",
                   file=sys.stderr, flush=True)
 
@@ -323,6 +377,10 @@ class OpsPlane:
         if self.health is not None:
             self.health.close()
             self.health = None
+        if self.slo is not None:
+            self.slo.stop()
+        if self.profiler is not None:
+            self.profiler.stop()
         if self.panel is not None:
             self.panel.stop()
         if self.flight.flight_dir is not None:

@@ -34,23 +34,39 @@ import json
 import os
 
 
-def load_dumps(directory: str) -> list[dict]:
-    """Every parseable flightdump-*.json under `directory` (sorted by
-    filename for stable output).  Unreadable/torn files are skipped —
-    a postmortem tool must not die on the evidence."""
+def load_dumps_with_errors(directory: str) -> tuple[list[dict],
+                                                    list[str]]:
+    """(parseable dumps, unreadable paths) for every flightdump-*.json
+    under `directory`, both sorted by filename for stable output.  A
+    postmortem tool must not die on the evidence — but a torn or
+    truncated dump is itself evidence (the process died mid-write, or
+    the disk did), so unreadable files are *reported*, never silently
+    dropped."""
     out = []
+    unreadable = []
     for path in sorted(glob.glob(os.path.join(directory,
                                               "flightdump-*.json"))):
         try:
             with open(path) as f:
                 d = json.load(f)
         except (OSError, ValueError):
+            unreadable.append(path)
             continue
         if isinstance(d, dict) and d.get("schema", "").startswith(
                 "kps-flightdump"):
             d["_path"] = path
             out.append(d)
-    return out
+        else:
+            # valid JSON but not a flight dump: same finding — the file
+            # claims the name, the contents don't back it up
+            unreadable.append(path)
+    return out, unreadable
+
+
+def load_dumps(directory: str) -> list[dict]:
+    """The parseable dumps only (compat shim; prefer
+    `load_dumps_with_errors`, which also surfaces torn files)."""
+    return load_dumps_with_errors(directory)[0]
 
 
 def _last_event_t(dump: dict) -> float:
@@ -60,8 +76,9 @@ def _last_event_t(dump: dict) -> float:
     return dump.get("dumpedAt", 0.0)
 
 
-def analyze(dumps: list[dict]) -> dict:
-    """Pure analysis over loaded dumps (tests drive this directly)."""
+def analyze(dumps: list[dict], unreadable: list[str] | None = None) -> dict:
+    """Pure analysis over loaded dumps (tests drive this directly).
+    `unreadable` paths ride through to the report as findings."""
     processes = []
     known_shards: set[int] = set()
     present_shards: set[int] = set()
@@ -133,6 +150,7 @@ def analyze(dumps: list[dict]) -> dict:
         "lastAcks": {s: last_acks[s] for s in dead if s in last_acks},
         "watchdogTrips": trips,
         "gateStalls": gate_stalls[-10:],
+        "unreadable": list(unreadable or ()),
     }
 
 
@@ -148,6 +166,9 @@ def format_report(report: dict) -> str:
                      for p in procs))
     if report["knownShards"]:
         lines.append(f"known shards: {report['knownShards']}")
+    for path in report.get("unreadable", ()):
+        lines.append(f"unreadable dump: {path} — torn/truncated "
+                     "(a process died mid-write?) or not a flight dump")
     for s in report["deadShards"]:
         lines.append(f"dead shard {s}: no flight dump — killed, or its "
                      f"dump was lost")
@@ -174,10 +195,12 @@ def format_report(report: dict) -> str:
 
 
 def main(directory: str) -> int:
-    dumps = load_dumps(directory)
+    dumps, unreadable = load_dumps_with_errors(directory)
     if not dumps:
-        print(f"postmortem: no flight dumps under {directory}")
+        for path in unreadable:
+            print(f"unreadable dump: {path}")
+        print(f"postmortem: no readable flight dumps under {directory}")
         return 1
-    report = analyze(dumps)
+    report = analyze(dumps, unreadable)
     print(format_report(report))
     return 0

@@ -48,6 +48,38 @@ LATENCY_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
 CLOCK_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0)
 
 
+def interp_quantile(bounds, counts, total: int, q: float) -> float | None:
+    """Counts-based quantile estimate with linear interpolation inside
+    the bucket holding the q-th sample.  The first bucket's lower edge
+    is 0.0 (every histogram here measures nonnegative ms/counts); the
+    +Inf overflow bucket clamps to the last finite edge — an estimator
+    must never invent a value past what the buckets can witness.
+    None before any observation.
+
+    Shared by `Histogram.quantile` and by windowed bucket-DELTA
+    consumers (telemetry/slo.py, telemetry/critpath.py), which subtract
+    two `state()` snapshots and need the same math over the difference.
+    """
+    if total <= 0:
+        return None
+    rank = q * total
+    seen = 0
+    n = len(bounds)
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        if seen + c >= rank:
+            if i >= n:                      # +Inf overflow bucket
+                return bounds[-1] if n else math.inf
+            lo = bounds[i - 1] if i else 0.0
+            frac = (rank - seen) / c
+            if frac < 0.0:
+                frac = 0.0
+            return lo + frac * (bounds[i] - lo)
+        seen += c
+    return bounds[-1] if n else math.inf
+
+
 def model_name(consistency_model: int) -> str:
     """Stable label value for the three consistency models
     (utils/config.py: 0 BSP, k>0 SSP, -1 ASP)."""
@@ -120,21 +152,12 @@ class Histogram:
             return list(self.bucket_counts), self.sum, self.count
 
     def quantile(self, q: float) -> float | None:
-        """Bucket-resolution quantile estimate (upper edge of the bucket
-        holding the q-th sample; the +Inf bucket reports the largest
-        finite edge).  None before any observation."""
+        """Quantile estimate, linearly interpolated inside the bucket
+        holding the q-th sample (the +Inf bucket clamps to the largest
+        finite edge — see `interp_quantile`).  None before any
+        observation."""
         counts, _, total = self.state()
-        if total == 0:
-            return None
-        rank = q * total
-        seen = 0
-        for i, c in enumerate(counts):
-            seen += c
-            if seen >= rank and c:
-                if i < len(self.bounds):
-                    return self.bounds[i]
-                return self.bounds[-1] if self.bounds else math.inf
-        return self.bounds[-1] if self.bounds else math.inf
+        return interp_quantile(self.bounds, counts, total, q)
 
     def summary(self) -> dict:
         counts, total_sum, total = self.state()

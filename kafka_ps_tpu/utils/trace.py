@@ -79,6 +79,25 @@ class Tracer:
                     "args": args,
                 })
 
+    def span_at(self, name: str, start: float, end: float, **args) -> None:
+        """Record a complete span from two clock values already taken
+        (same clock as this tracer, time.perf_counter by default).
+        For retroactive sections whose start predates the decision to
+        record them — e.g. the consistency gate's hold time, known only
+        at release (runtime/server.py:_observe_gate_release)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append({
+                "name": name,
+                "ph": "X",
+                "ts": (start - self._t0) * 1e6,
+                "dur": max(0.0, end - start) * 1e6,
+                "pid": self.pid,
+                "tid": threading.get_ident() % 2 ** 31,
+                "args": args,
+            })
+
     # -- counters (message-flow view) --------------------------------------
     def count(self, name: str, n: int = 1) -> None:
         if not self.enabled:
@@ -125,6 +144,16 @@ class Tracer:
 
     def flow_end(self, name: str, flow_id: int, **args) -> None:
         self.flow("f", name, flow_id, **args)
+
+    def clear(self) -> None:
+        """Drop every recorded event and counter sample (the
+        warmup-then-measure pattern: run until jit compiles settle,
+        clear, then trace steady state).  Flow ids keep advancing, so
+        post-clear events never collide with discarded ones; a flow
+        whose start was discarded is simply unmatched downstream."""
+        with self._lock:
+            self._events.clear()
+            self._counter_samples.clear()
 
     # -- export ------------------------------------------------------------
     def counters(self) -> dict[str, int]:

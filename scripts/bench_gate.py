@@ -1,0 +1,332 @@
+#!/usr/bin/env python
+"""Bench regression gate — compare a fresh bench_out.json against the
+best committed baseline per metric, with direction-aware tolerances.
+
+    python scripts/bench_gate.py                       # repo defaults
+    python scripts/bench_gate.py --fresh bench_out.json \
+        --baseline 'BENCH_r*.json' --waivers scripts/bench_waivers.txt
+
+Baselines may be any of three shapes: a harness capture record
+({n, cmd, rc, tail, parsed} — `parsed` when present, else the last
+JSON line of `tail`), a full bench payload (the bench_out.json shape),
+or a bare summary line.  Shapes that yield no metrics are reported and
+skipped, never fatal — history must not be able to wedge the gate.
+
+Rules, per canonical metric:
+
+  * higher-is-better throughput (worker updates/s, knee QPS, speedups)
+    may not drop more than its relative tolerance (default 15%) below
+    the BEST baseline value;
+  * lower-is-better latency may not rise more than its tolerance above
+    the best (lowest) baseline;
+  * absolute caps (telemetry/flight/profiling overhead %) are checked
+    against the FRESH file alone — they re-state the asserts bench.py
+    already enforces at run time, so a hand-edited bench_out.json
+    cannot sneak past;
+  * bitwise keys must be exactly true in the fresh file;
+  * performance comparisons only count between runs of the same device
+    class (a CPU dev box must not "regress" a TPU baseline) — a class
+    mismatch is a named SKIP, not a pass.
+
+Waivers (scripts/bench_waivers.txt, one per line):
+
+    <metric-key>: <reason why this regression is accepted>
+
+A waived metric still prints its comparison but cannot fail the gate.
+Blank lines and `#` comments are ignored.  Exit code: 0 when no
+unwaived metric fails, 1 otherwise, 2 on an unreadable fresh file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def _get(doc, *path):
+    """Nested lookup; None on any miss."""
+    cur = doc
+    for key in path:
+        if not isinstance(cur, dict) or key not in cur:
+            return None
+        cur = cur[key]
+    return cur
+
+
+def _scalar(v):
+    """Collapse rate_stats dicts to their median; pass scalars through."""
+    if isinstance(v, dict):
+        v = v.get("median")
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return v
+    return float(v)
+
+
+# Canonical metrics.  `paths` are tried in order against both the old
+# (pre-PR-14 harness `parsed`) and current bench_out.json layouts; the
+# summary-line layout is handled by prefixing ("summary",).
+# direction: "higher" | "lower";  rel = relative tolerance vs the best
+# baseline;  cap = absolute ceiling checked on the fresh file alone;
+# must_be_true = bitwise/bool contract on the fresh file alone.
+METRICS = {
+    "worker_updates_per_sec": {
+        "paths": [("value",)], "direction": "higher", "rel": 0.15},
+    "server_rounds_per_sec": {
+        "paths": [("detail", "server_rounds_per_sec"),
+                  ("server_rounds_per_sec",)],
+        "direction": "higher", "rel": 0.15},
+    "final_f1": {
+        "paths": [("detail", "final_f1"), ("final_f1",)],
+        "direction": "higher", "abs": 0.02, "device_free": True,
+        "same_dataset": True},
+    "fused_mlp_rounds_per_sec": {
+        "paths": [("detail", "paths", "fused_mlp_rounds_per_sec")],
+        "direction": "higher", "rel": 0.15},
+    "per_node_eval1": {
+        "paths": [("detail", "paths",
+                   "per_node_iters_per_sec_eval_every_1"),
+                  ("per_node_eval1",)],
+        "direction": "higher", "rel": 0.15},
+    "per_node_eval10": {
+        "paths": [("detail", "paths",
+                   "per_node_iters_per_sec_eval_every_10"),
+                  ("per_node_eval10",)],
+        "direction": "higher", "rel": 0.15},
+    "pallas_speedup": {
+        "paths": [("detail", "paths", "pallas_ab", "pallas_speedup"),
+                  ("pallas_speedup",)],
+        "direction": "higher", "rel": 0.15},
+    "serving_p50_ms": {
+        "paths": [("detail", "paths", "serving_ab", "batched", "p50_ms"),
+                  ("serving_p50_ms",)],
+        "direction": "lower", "rel": 0.25},
+    "serving_knee_qps": {
+        "paths": [("detail", "paths", "serving_load", "single",
+                   "knee_qps"), ("serving_knee_qps",)],
+        "direction": "higher", "rel": 0.15},
+    "tier_hot_hit_rate": {
+        "paths": [("detail", "paths", "tiering_ab", "skew_drive",
+                   "hit_rate", "hot"), ("tier_hot_hit_rate",)],
+        "direction": "higher", "abs": 0.05, "device_free": True},
+    # absolute caps — the observability planes' cost contracts
+    "telemetry_overhead_pct": {
+        "paths": [("detail", "paths", "telemetry_overhead",
+                   "overhead_pct"), ("telemetry_overhead_pct",)],
+        "direction": "lower", "cap": 5.0},
+    "flight_overhead_pct": {
+        "paths": [("detail", "paths", "flight_overhead",
+                   "max_overhead_pct"), ("flight_overhead_pct",)],
+        "direction": "lower", "cap": 2.0},
+    "profiling_overhead_pct": {
+        "paths": [("detail", "paths", "profiling_overhead",
+                   "max_overhead_pct"), ("profiling_overhead_pct",)],
+        "direction": "lower", "cap": 2.0},
+    # bitwise contracts — never degradable, never device-scoped
+    "telemetry_bitwise": {
+        "paths": [("detail", "paths", "telemetry_overhead",
+                   "theta_bitwise_identical"), ("telemetry_bitwise",)],
+        "must_be_true": True},
+    "flight_bitwise": {
+        "paths": [("flight_bitwise",)], "must_be_true": True,
+        "all_of": ("detail", "paths", "flight_overhead")},
+    "profiling_bitwise": {
+        "paths": [("profiling_bitwise",)], "must_be_true": True,
+        "all_of": ("detail", "paths", "profiling_overhead")},
+    "tier_bitwise": {
+        "paths": [("detail", "paths", "tiering_ab", "all_bitwise"),
+                  ("tier_bitwise",)], "must_be_true": True},
+}
+
+_MODELS = ("sequential", "bounded", "eventual")
+
+
+def extract(doc: dict, key: str) -> object:
+    spec = METRICS[key]
+    # per-model bitwise blocks fold to a single all() verdict
+    block_path = spec.get("all_of")
+    if block_path:
+        block = _get(doc, *block_path)
+        if isinstance(block, dict):
+            flags = [_get(block, m, "theta_bitwise_identical")
+                     for m in _MODELS]
+            if all(isinstance(f, bool) for f in flags):
+                return all(flags)
+    for path in spec["paths"]:
+        v = _scalar(_get(doc, *path))
+        if v is None:
+            v = _scalar(_get(doc, "summary", *path))
+        if v is not None:
+            return v
+    return None
+
+
+def device_class(doc: dict) -> str | None:
+    dev = _get(doc, "detail", "device")
+    if not isinstance(dev, str):
+        return None
+    return "tpu" if "tpu" in dev.lower() else "cpu"
+
+
+def load_baseline(path: str) -> tuple[dict | None, str]:
+    """(document, note).  Harness records unwrap to `parsed`, falling
+    back to the last parseable JSON line of `tail`."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return None, f"unreadable ({e.__class__.__name__})"
+    if not isinstance(doc, dict):
+        return None, "not a JSON object"
+    if "tail" in doc and "parsed" in doc:            # harness record
+        if isinstance(doc.get("parsed"), dict):
+            return doc["parsed"], "harness parsed"
+        for line in reversed(doc.get("tail", "").splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line), "harness tail"
+                except ValueError:
+                    continue
+        return None, "harness record with no parseable summary"
+    return doc, "payload"
+
+
+def load_waivers(path: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    if not path or not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or ":" not in line:
+                continue
+            key, reason = line.split(":", 1)
+            out[key.strip()] = reason.strip()
+    return out
+
+
+def run_gate(fresh_path: str, baseline_paths: list[str],
+             waiver_path: str, out=sys.stdout) -> int:
+    try:
+        with open(fresh_path) as f:
+            fresh = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench-gate: cannot read fresh results {fresh_path}: "
+              f"{e}", file=out)
+        return 2
+
+    waivers = load_waivers(waiver_path)
+    baselines: list[tuple[str, dict]] = []
+    for path in baseline_paths:
+        doc, note = load_baseline(path)
+        if doc is None:
+            print(f"bench-gate: SKIP baseline {path} — {note}", file=out)
+        else:
+            baselines.append((path, doc))
+
+    fresh_class = device_class(fresh)
+    failures: list[str] = []
+    for key, spec in METRICS.items():
+        val = extract(fresh, key)
+        if val is None:
+            print(f"bench-gate: SKIP {key} — absent from fresh "
+                  "results", file=out)
+            continue
+
+        def fail(msg):
+            if key in waivers:
+                print(f"bench-gate: WAIVED {key} — {msg} "
+                      f"(waiver: {waivers[key]})", file=out)
+            else:
+                failures.append(key)
+                print(f"bench-gate: FAIL {key} — {msg}", file=out)
+
+        if spec.get("must_be_true"):
+            if val is True:
+                print(f"bench-gate: ok {key}=true", file=out)
+            else:
+                fail(f"expected true, got {val!r}")
+            continue
+        cap = spec.get("cap")
+        if cap is not None and isinstance(val, float) and val >= cap:
+            fail(f"{val} >= cap {cap}")
+            continue
+
+        # best comparable baseline value for this key
+        cands = []
+        for path, doc in baselines:
+            bval = extract(doc, key)
+            if not isinstance(bval, float):
+                continue
+            if not spec.get("device_free"):
+                bclass = device_class(doc)
+                if bclass is None or fresh_class is None \
+                        or bclass != fresh_class:
+                    print(f"bench-gate: SKIP {key} vs {path} — device "
+                          f"class {bclass or '?'} != "
+                          f"{fresh_class or '?'}", file=out)
+                    continue
+            if spec.get("same_dataset"):
+                # quality metrics only compare like against like: a
+                # dataset change moves the attainable ceiling
+                bds = _get(doc, "detail", "dataset")
+                fds = _get(fresh, "detail", "dataset")
+                if bds != fds:
+                    print(f"bench-gate: SKIP {key} vs {path} — "
+                          f"dataset {bds!r} != {fds!r}", file=out)
+                    continue
+            cands.append(bval)
+        if not cands or not isinstance(val, float):
+            if cap is not None:
+                print(f"bench-gate: ok {key}={val} (cap {cap}, no "
+                      "comparable baseline)", file=out)
+            else:
+                print(f"bench-gate: SKIP {key} — no comparable "
+                      "baseline", file=out)
+            continue
+
+        higher = spec.get("direction", "higher") == "higher"
+        best = max(cands) if higher else min(cands)
+        tol_abs = spec.get("abs")
+        if tol_abs is None:
+            tol_abs = abs(best) * spec.get("rel", 0.15)
+        limit = best - tol_abs if higher else best + tol_abs
+        bad = val < limit if higher else val > limit
+        if bad:
+            fail(f"fresh={val} vs best baseline={best} "
+                 f"(limit {round(limit, 4)})")
+        else:
+            print(f"bench-gate: ok {key}={val} (best baseline {best}, "
+                  f"limit {round(limit, 4)})", file=out)
+
+    if failures:
+        print(f"bench-gate: {len(failures)} metric(s) regressed: "
+              + ", ".join(failures), file=out)
+        return 1
+    print("bench-gate: pass", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compare fresh bench results against committed "
+                    "baselines")
+    ap.add_argument("--fresh", default="bench_out.json")
+    ap.add_argument("--baseline", action="append", default=None,
+                    help="baseline file or glob (repeatable; default "
+                         "BENCH_r*.json + last committed bench_out)")
+    ap.add_argument("--waivers", default="scripts/bench_waivers.txt")
+    args = ap.parse_args(argv)
+    pats = args.baseline if args.baseline else ["BENCH_r*.json"]
+    paths: list[str] = []
+    for pat in pats:
+        hits = sorted(glob.glob(pat))
+        paths.extend(hits if hits else [pat])
+    return run_gate(args.fresh, paths, args.waivers)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,16 +62,25 @@
 # binary is installed.
 #
 # `scripts/tier1.sh --obs` runs the observability smoke leg in two
-# phases (docs/OBSERVABILITY.md): (1) a short socket-bridged run with
-# tracing and metrics on (two tracers with distinct pids standing in
-# for the `--listen --trace` / `--connect --trace` processes),
-# asserting the merged trace contains >= 1 cross-process flow and the
-# Prometheus dump parses with the staleness histogram families
-# populated; (2) a subprocess fleet (2 shard servers + 1 worker, all
-# with --flight-dir) where shard 1 is SIGKILLed mid-run — the
-# survivors' flight dumps must exist, the killed shard's must not, and
-# `python -m kafka_ps_tpu.telemetry postmortem` must exit 0 naming the
-# dead shard and its last acknowledged weights send (POSTMORTEM_OK).
+# phases (docs/OBSERVABILITY.md): (1) one short socket-bridged run PER
+# consistency model with tracing and metrics on (tracer pid pairs
+# standing in for the `--listen --trace` / `--connect --trace`
+# processes), asserting the six-trace merge contains >= 1 cross-process
+# flow, the Prometheus dump parses with the staleness histogram
+# families populated, and `python -m kafka_ps_tpu.telemetry critpath`
+# exits 0 over the merged trace naming a dominant segment per model —
+# BSP's must be gate_wait (OBS_CRITPATH_OK); (2) a subprocess fleet
+# (2 shard servers + 1 worker, all with --flight-dir) where shard 1 is
+# SIGKILLed mid-run — the survivors' flight dumps must exist, the
+# killed shard's must not, and `python -m kafka_ps_tpu.telemetry
+# postmortem` must exit 0 naming the dead shard and its last
+# acknowledged weights send (POSTMORTEM_OK).
+#
+# `scripts/tier1.sh --bench-gate` runs the bench regression gate
+# (scripts/bench_gate.py): the committed bench_out.json must pass
+# against the committed BENCH_r*.json baselines, and a synthetic 20%
+# worker-throughput regression must FAIL the gate naming the metric
+# (BENCH_GATE_OK).  Waivers: scripts/bench_waivers.txt.
 set -o pipefail
 
 if [[ "${1:-}" == "--analyze" ]]; then
@@ -413,8 +422,12 @@ fi
 
 if [[ "${1:-}" == "--obs" ]]; then
     timeout -k 10 540 env JAX_PLATFORMS=cpu python - <<'EOF'
+import re
+import subprocess
+import sys
 import tempfile
 import threading
+import time
 from pathlib import Path
 
 from kafka_ps_tpu.data.buffer import SlidingBuffer
@@ -431,72 +444,114 @@ from kafka_ps_tpu.utils.trace import Tracer
 model = ModelConfig(num_features=64, num_classes=2)
 x, y = generate_hard(512 + 500, num_features=64, num_classes=2, seed=9)
 test_x, test_y = x[-500:], y[-500:]
-ids = [0, 1]
-cfg = PSConfig(num_workers=2, consistency_model=2, model=model,
-               buffer=BufferConfig(min_size=32, max_size=256),
-               eval_every=10**9, use_gang=False)
-# two tracers with distinct pids stand in for the two PROCESSES the
-# socket deployment runs (`--listen --trace` / `--connect --trace`)
-tr_s, tr_w = Tracer(pid=1001), Tracer(pid=2002)
-tel_s, tel_w = Telemetry(tracer=tr_s), Telemetry(tracer=tr_w)
-sbridge = net.ServerBridge(port=0, run_id=1, tracer=tr_s, telemetry=tel_s)
-sfabric = sbridge.wrap(fabric_mod.Fabric())
-server = ServerNode(cfg, sfabric, test_x, test_y, NullLogSink(),
-                    tracer=tr_s, telemetry=tel_s)
-wbridge = net.WorkerBridge("127.0.0.1", sbridge.port, ids,
-                           tracer=tr_w, telemetry=tel_w)
-assert wbridge.trace_negotiated, "trace context did not negotiate on"
-wfabric = wbridge.make_fabric()
-buffers = {w: SlidingBuffer(64, cfg.buffer, telemetry=tel_w, worker=w)
-           for w in ids}
-nodes = {w: WorkerNode(w, cfg, wfabric, buffers[w], test_x, test_y,
-                       NullLogSink(), tracer=tr_w, telemetry=tel_w)
-         for w in ids}
-for w in ids:
-    for i in range(w, 512, 2):
-        buffers[w].add(dict(enumerate(x[i])), int(y[i]))
-reader = threading.Thread(target=wbridge.run_reader, args=(buffers,),
-                          daemon=True)
-reader.start()
-for w in ids:
-    wbridge.mark_ready(w)
-sbridge.wait_for_connected(ids, timeout=30)
-sbridge.wait_for_workers(ids, timeout=30)
-stop = threading.Event()
-def worker_loop(node):
-    try:
-        while not stop.is_set():
-            m = wfabric.poll_blocking(fabric_mod.WEIGHTS_TOPIC,
-                                      node.worker_id, timeout=0.05)
-            if m is not None:
-                node.on_weights(m)
-    except (ConnectionError, OSError):
-        pass
-ts = [threading.Thread(target=worker_loop, args=(nodes[w],), daemon=True)
-      for w in ids]
-for t in ts:
-    t.start()
-server.start_training_loop()
-while server.iterations < 24:
-    g = sfabric.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0, timeout=0.2)
-    if g is not None:
-        server.process(g)
-stop.set()
-sbridge.close()
-for t in ts:
-    t.join(timeout=120)
-wbridge.close()
-reader.join(timeout=10)
-server.log.close()
-
+# three workers, one straggler: worker 2 lags STRAGGLER_LAG_S before
+# each local step.  Under BSP the gate then withholds the round's
+# weights from BOTH fast workers until the straggler reports, so
+# gate_wait accrues 2x the lag per round while buffer_wait (charged to
+# the straggler's own flows) accrues 1x — the decomposition must
+# convict the gate, not the wire, and with a 2x margin it does so
+# robustly.  This is the scenario critical-path analysis exists for.
+ids = [0, 1, 2]
+STRAGGLER, STRAGGLER_LAG_S = 2, 0.012
 out = Path(tempfile.mkdtemp(prefix="kps-obs-"))
-pw, ps = str(out / "worker.trace.json"), str(out / "server.trace.json")
-tr_w.dump(pw)
-tr_s.dump(ps)
-stats = merge_traces([pw, ps], str(out / "merged.json"))
-assert stats["cross_process_flows"] >= 1, stats
-assert sorted(stats["pids"]) == [1001, 2002], stats
 
+
+def run_traced(c, pid_s, pid_w):
+    """One short socket-bridged run under consistency model `c`; two
+    tracers with distinct pids stand in for the two PROCESSES the
+    socket deployment runs (`--listen --trace` / `--connect --trace`).
+    Returns the worker/server trace paths and the server telemetry."""
+    cfg = PSConfig(num_workers=3, consistency_model=c, model=model,
+                   buffer=BufferConfig(min_size=32, max_size=256),
+                   eval_every=10**9, use_gang=False)
+    tr_s, tr_w = Tracer(pid=pid_s), Tracer(pid=pid_w)
+    tel_s, tel_w = Telemetry(tracer=tr_s), Telemetry(tracer=tr_w)
+    sbridge = net.ServerBridge(port=0, run_id=1, tracer=tr_s,
+                               telemetry=tel_s)
+    sfabric = sbridge.wrap(fabric_mod.Fabric())
+    server = ServerNode(cfg, sfabric, test_x, test_y, NullLogSink(),
+                        tracer=tr_s, telemetry=tel_s)
+    wbridge = net.WorkerBridge("127.0.0.1", sbridge.port, ids,
+                               tracer=tr_w, telemetry=tel_w)
+    assert wbridge.trace_negotiated, "trace context did not negotiate on"
+    wfabric = wbridge.make_fabric()
+    buffers = {w: SlidingBuffer(64, cfg.buffer, telemetry=tel_w, worker=w)
+               for w in ids}
+    nodes = {w: WorkerNode(w, cfg, wfabric, buffers[w], test_x, test_y,
+                           NullLogSink(), tracer=tr_w, telemetry=tel_w)
+             for w in ids}
+    for w in ids:
+        for i in range(w, 512, len(ids)):
+            buffers[w].add(dict(enumerate(x[i])), int(y[i]))
+    reader = threading.Thread(target=wbridge.run_reader, args=(buffers,),
+                              daemon=True)
+    reader.start()
+    for w in ids:
+        wbridge.mark_ready(w)
+    sbridge.wait_for_connected(ids, timeout=30)
+    sbridge.wait_for_workers(ids, timeout=30)
+    stop = threading.Event()
+
+    def worker_loop(node, lag_s):
+        try:
+            while not stop.is_set():
+                m = wfabric.poll_blocking(fabric_mod.WEIGHTS_TOPIC,
+                                          node.worker_id, timeout=0.05)
+                if m is not None:
+                    if lag_s:
+                        time.sleep(lag_s)   # the straggler's lag
+                    node.on_weights(m)
+        except (ConnectionError, OSError):
+            pass
+    ts = [threading.Thread(
+              target=worker_loop,
+              args=(nodes[w],
+                    STRAGGLER_LAG_S if w == STRAGGLER else 0.0),
+              daemon=True) for w in ids]
+    for t in ts:
+        t.start()
+    server.start_training_loop()
+    # warmup: run until the jit compiles (worker local_update, server
+    # apply) have all fired, then clear both tracers — the critical
+    # path must reflect steady state, not one-time compilation stalls
+    while server.iterations < 8:
+        g = sfabric.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0,
+                                  timeout=0.2)
+        if g is not None:
+            server.process(g)
+    tr_s.clear()
+    tr_w.clear()
+    while server.iterations < 32:
+        g = sfabric.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0,
+                                  timeout=0.2)
+        if g is not None:
+            server.process(g)
+    stop.set()
+    sbridge.close()
+    for t in ts:
+        t.join(timeout=120)
+    wbridge.close()
+    reader.join(timeout=10)
+    server.log.close()
+    pw = str(out / f"worker.{pid_w}.trace.json")
+    ps = str(out / f"server.{pid_s}.trace.json")
+    tr_w.dump(pw)
+    tr_s.dump(ps)
+    return pw, ps, tel_s
+
+
+# one run per consistency model, distinct pid pairs, so all six traces
+# merge onto ONE timeline and the critical-path CLI sees every model
+runs = {0: run_traced(0, 1001, 2002),
+        2: run_traced(2, 1003, 2004),
+        -1: run_traced(-1, 1005, 2006)}
+traces = [p for pw, ps, _ in runs.values() for p in (pw, ps)]
+stats = merge_traces(traces, str(out / "merged.json"))
+assert stats["cross_process_flows"] >= 1, stats
+assert sorted(stats["pids"]) == [1001, 1003, 1005,
+                                 2002, 2004, 2006], stats
+
+tel_s = runs[2][2]
 metrics = str(out / "metrics.prom")
 tel_s.write_prometheus(metrics)
 text = Path(metrics).read_text()
@@ -512,6 +567,25 @@ assert snap["gate_wait_ms"]["model=bounded"]["count"] > 0, snap
 print(f"OBS_SMOKE_OK flows={stats['cross_process_flows']} "
       f"events={stats['events']} pids={sorted(stats['pids'])} "
       f"metric_families={len(snap)}")
+
+# ---- critical-path decomposition over the merged trace ---------------
+# the CLI must exit 0, decompose flows for EVERY consistency model, and
+# convict gate_wait as BSP's dominant segment (the sequential gate
+# holds weights until the whole round arrives — that wait IS the
+# model's defining cost, docs/OBSERVABILITY.md "Critical-path analysis")
+cp = subprocess.run(
+    [sys.executable, "-m", "kafka_ps_tpu.telemetry", "critpath",
+     str(out / "merged.json")], capture_output=True, text=True,
+    timeout=120)
+assert cp.returncode == 0, (
+    f"critpath rc={cp.returncode}\n{cp.stdout}{cp.stderr}")
+doms = dict(re.findall(r"^model=(\S+) flows=\d+ dominant=(\S+)",
+                       cp.stdout, re.M))
+for m in ("sequential", "bounded", "eventual"):
+    assert m in doms, (doms, cp.stdout)
+assert doms["sequential"] == "gate_wait", (doms, cp.stdout)
+print(f"OBS_CRITPATH_OK dominants=" + ",".join(
+    f"{m}:{d}" for m, d in sorted(doms.items())))
 
 # ---- phase 2: black-box postmortem of a SIGKILLed shard --------------
 # A real split-deployment fleet (2 shard servers + 1 worker process, the
@@ -631,6 +705,44 @@ assert "dead shard 1" in pm.stdout, pm.stdout
 assert "last ack from shard 1" in pm.stdout, pm.stdout
 print(f"POSTMORTEM_OK dumps={len(dumps)} dead_shard=1 "
       f"survivors={sorted(pids)}")
+EOF
+    exit $?
+fi
+
+if [[ "${1:-}" == "--bench-gate" ]]; then
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+repo = os.getcwd()
+
+def gate(*args):
+    return subprocess.run(
+        [sys.executable, "scripts/bench_gate.py", *args],
+        cwd=repo, capture_output=True, text=True, timeout=90)
+
+# the committed results must pass against the committed baselines
+ok = gate()
+assert ok.returncode == 0, (
+    f"gate failed on committed results rc={ok.returncode}\n"
+    f"{ok.stdout}{ok.stderr}")
+
+# a synthetic 20% worker-throughput regression (same device class:
+# the baseline is the committed file itself) must fail, naming the key
+with open(os.path.join(repo, "bench_out.json")) as fh:
+    doc = json.load(fh)
+doc["value"] = round(doc["value"] * 0.8, 1)
+deg = os.path.join(tempfile.mkdtemp(prefix="kps-gate-"), "degraded.json")
+with open(deg, "w") as fh:
+    json.dump(doc, fh)
+bad = gate("--fresh", deg, "--baseline", "bench_out.json")
+assert bad.returncode == 1, (
+    f"gate missed a 20% regression rc={bad.returncode}\n{bad.stdout}")
+assert "FAIL worker_updates_per_sec" in bad.stdout, bad.stdout
+print("BENCH_GATE_OK")
 EOF
     exit $?
 fi

@@ -1,0 +1,9 @@
+"""PS104 positive fixture (scoped: telemetry/critpath.py is a derived
+observability module): a critical-path verdict must be a pure function
+of recorded trace data, not of when the analyzer happened to run."""
+import time
+
+
+def stamp_verdict(verdict):
+    verdict["analyzed_at"] = time.time()
+    return verdict

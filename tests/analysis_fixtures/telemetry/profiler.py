@@ -1,0 +1,13 @@
+"""PS104/PS106 negative fixture (scoped: telemetry/profiler.py):
+monotonic pacing and host-scalar-only instrumentation are clean even
+under the derived-observability rules."""
+
+import time
+
+
+def pace(last, hz):
+    return time.monotonic() - last >= 1.0 / hz
+
+
+def record(counter, stacks):
+    counter.inc(len(stacks))

@@ -1,0 +1,7 @@
+"""PS106 positive fixture (scoped: telemetry/slo.py): an SLO sampler
+that fetches a device value inside a telemetry call's arguments forces
+a host sync on the instrumentation path."""
+
+
+def sample(hist, loss):
+    hist.observe(float(loss))

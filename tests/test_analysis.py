@@ -42,6 +42,8 @@ def _findings(relpath: str):
     ("serving/dispatch_ps106_bad.py", "PS106"),
     ("runtime/ps106_bad.py", "PS106"),
     ("runtime/ps106_flight_bad.py", "PS106"),
+    ("telemetry/critpath.py", "PS104"),
+    ("telemetry/slo.py", "PS106"),
 ])
 def test_positive_fixture_triggers_exactly_once(relpath, rule):
     found = _findings(relpath)
@@ -67,6 +69,7 @@ def test_positive_fixture_triggers_exactly_once(relpath, rule):
     "serving/dispatch_ps106_ok.py",
     "runtime/ps106_ok.py",
     "runtime/ps106_flight_ok.py",
+    "telemetry/profiler.py",
 ])
 def test_negative_fixture_stays_clean(relpath):
     assert _findings(relpath) == []
@@ -97,6 +100,18 @@ def test_suppression_on_preceding_line():
            "    return time.time()\n")
     (f,) = pscheck.analyze_source(src, "log/clock.py").findings
     assert f.suppressed
+
+
+def test_profiler_wall_anchor_suppression_carries_reason():
+    # the one sanctioned wall-clock read in the derived-observability
+    # modules: the profiler's display-only start timestamp
+    src = ("import time\n"
+           "def start(self):\n"
+           "    self.started_wall = time.time()  "
+           "# pscheck: disable=PS104 (display-only wall anchor)\n")
+    (f,) = pscheck.analyze_source(src, "telemetry/profiler.py").findings
+    assert f.rule == "PS104" and f.suppressed
+    assert f.reason == "display-only wall anchor"
 
 
 def test_rule_scoping_is_path_based():

@@ -114,6 +114,32 @@ def test_dump_schema_roundtrip(tmp_path):
     fr.disable()
 
 
+def test_flight_dump_carries_profile_stacks(tmp_path):
+    """A dump taken while the sampling profiler is attached must embed
+    the hottest collapsed stacks — that is what makes a watchdog-tripped
+    dump self-explanatory."""
+    from kafka_ps_tpu.telemetry.profiler import SamplingProfiler
+    fr = FlightRecorder(capacity=16)
+    fr.enable(role="run", flight_dir=str(tmp_path))
+    prof = SamplingProfiler(hz=200.0)
+    fr.profiler = prof
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="kps-busy-fixture",
+                         daemon=True)
+    t.start()
+    try:
+        for _ in range(10):
+            prof.sample_once()
+        d = json.loads(Path(fr.dump(reason="test")).read_text())
+    finally:
+        stop.set()
+        t.join()
+        fr.disable()
+    assert d["profile"], "dump must contain profile stacks"
+    assert any("kps-busy-fixture" in line for line in d["profile"])
+    assert fr.profiler is None           # disable() detaches it
+
+
 # -- watchdog semantics (PINNED) -------------------------------------------
 
 def test_watchdog_beats_restart_the_window():
@@ -416,6 +442,35 @@ def test_postmortem_surfaces_watchdog_trips(tmp_path):
     (trip,) = report["watchdogTrips"]
     assert trip["watchdog"] == "gate"
     assert "watchdog trip" in postmortem.format_report(report)
+
+
+def test_postmortem_reports_torn_dump_but_still_analyzes(tmp_path, capsys):
+    """A process killed mid-write leaves a truncated dump.  The analyzer
+    must not die on it: the torn file becomes a finding, the readable
+    dumps still analyze."""
+    _dump_file(tmp_path, "flightdump-10.json", pid=10, role="server",
+               shard=0, meta={"shards": [0]})
+    full = (tmp_path / "flightdump-10.json").read_text()
+    (tmp_path / "flightdump-99.json").write_text(full[: len(full) // 2])
+    # valid JSON that merely claims the filename is the same finding
+    (tmp_path / "flightdump-98.json").write_text('{"schema": "other"}')
+    dumps, unreadable = postmortem.load_dumps_with_errors(str(tmp_path))
+    assert len(dumps) == 1
+    assert [os.path.basename(p) for p in unreadable] == [
+        "flightdump-98.json", "flightdump-99.json"]
+    text = postmortem.format_report(postmortem.analyze(dumps, unreadable))
+    assert "unreadable dump:" in text and "flightdump-99.json" in text
+    assert "no dead shards" in text      # readable evidence still lands
+    assert postmortem.main(str(tmp_path)) == 0
+    assert "unreadable dump" in capsys.readouterr().out
+
+
+def test_postmortem_with_only_torn_dumps_names_them(tmp_path, capsys):
+    (tmp_path / "flightdump-1.json").write_text('{"events": [')
+    assert postmortem.main(str(tmp_path)) == 1   # no readable evidence
+    out = capsys.readouterr().out
+    assert "unreadable dump:" in out
+    assert "no readable flight dumps" in out
 
 
 def test_postmortem_cli_module(tmp_path):

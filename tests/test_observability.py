@@ -1,0 +1,359 @@
+"""Critical-path decomposition, the sampling profiler, and the SLO /
+burn-rate plane (kafka_ps_tpu/telemetry/{critpath,profiler,slo}.py).
+
+The critpath tests pin the stitch over a hand-built synthetic trace —
+every segment's arithmetic is asserted against timestamps chosen on
+paper, so a regression in the join logic (span containment, flow
+matching, the gate's fork) shows up as a wrong millisecond, not a
+flaky smoke run.  The SLO tests drive `sample_once(now=...)` with an
+explicit clock, so burn-rate math is deterministic."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from kafka_ps_tpu.telemetry import FlightRecorder, Telemetry
+from kafka_ps_tpu.telemetry.critpath import (RollingCritpath, aggregate,
+                                             critpath_main, decompose)
+from kafka_ps_tpu.telemetry.health import HealthServer
+from kafka_ps_tpu.telemetry.profiler import SamplingProfiler
+from kafka_ps_tpu.telemetry.slo import (SLO, SLOPlane, count_le,
+                                        plane_from_args, standard_slos)
+
+
+# -- the synthetic trace ----------------------------------------------------
+# One gradient's full life, timestamps in µs, laid out on paper:
+#   weights land at the worker t=1000; local_update runs [3000, 5000];
+#   the delta leaves inside a net.send span at 5300, reaches
+#   server.apply [8800, 11800]; the gate releases at 16000; the
+#   publish step fires at 12100 and serving reads it at 13100.
+WORKER_PID, SERVER_PID, SERVE_PID = 2, 1, 3
+
+
+def _full_flow_events():
+    return [
+        # weights.wire: server start names the worker, worker end marks
+        # arrival (the buffer_wait anchor)
+        {"name": "weights.wire", "cat": "flow", "ph": "s", "id": 100,
+         "ts": 0.0, "pid": SERVER_PID, "args": {"worker": 0}},
+        {"name": "weights.wire", "cat": "flow", "ph": "f", "id": 100,
+         "ts": 1000.0, "pid": WORKER_PID, "args": {}},
+        {"name": "worker.local_update", "ph": "X", "ts": 3000.0,
+         "dur": 2000.0, "pid": WORKER_PID,
+         "args": {"worker": 0, "clock": 1}},
+        {"name": "net.send", "ph": "X", "ts": 5200.0, "dur": 400.0,
+         "pid": WORKER_PID, "args": {"topic": "gradients", "worker": 0}},
+        {"name": "delta.wire", "cat": "flow", "ph": "s", "id": 200,
+         "ts": 5300.0, "pid": WORKER_PID, "args": {}},
+        {"name": "server.apply", "ph": "X", "ts": 8800.0, "dur": 3000.0,
+         "pid": SERVER_PID,
+         "args": {"worker": 0, "clock": 1, "model": "sequential"}},
+        {"name": "delta.wire", "cat": "flow", "ph": "t", "id": 200,
+         "ts": 9000.0, "pid": SERVER_PID, "args": {"clock": 1}},
+        {"name": "gate.wait", "ph": "X", "ts": 9000.0, "dur": 7000.0,
+         "pid": SERVER_PID,
+         "args": {"worker": 0, "clock": 1, "model": "sequential"}},
+        {"name": "delta.wire", "cat": "flow", "ph": "t", "id": 200,
+         "ts": 12100.0, "pid": SERVER_PID, "args": {"step": "publish"}},
+        {"name": "delta.wire", "cat": "flow", "ph": "f", "id": 200,
+         "ts": 13100.0, "pid": SERVE_PID, "args": {}},
+    ]
+
+
+def test_decompose_full_flow_every_segment():
+    flows = decompose(_full_flow_events())
+    assert len(flows) == 1
+    fl = flows[0]
+    assert fl["model"] == "sequential"
+    seg = fl["segments"]
+    assert seg["buffer_wait"] == pytest.approx(2.0)    # 1000 -> 3000
+    assert seg["local_train"] == pytest.approx(2.0)    # dur 2000µs
+    assert seg["wire"] == pytest.approx(3.8)           # 5000 -> 8800
+    assert seg["apply"] == pytest.approx(3.0)          # dur 3000µs
+    assert seg["gate_wait"] == pytest.approx(4.2)      # 11800 -> 16000
+    assert seg["publish"] == pytest.approx(0.3)        # 11800 -> 12100
+    assert seg["serving_read"] == pytest.approx(1.0)   # 12100 -> 13100
+
+
+def test_decompose_wire_fallback_without_worker_identity():
+    # gang path: no local_update span matches, no send span encloses
+    # the start — wire degrades to send->apply-step, nothing else
+    events = [
+        {"name": "delta.wire", "cat": "flow", "ph": "s", "id": 7,
+         "ts": 1000.0, "pid": WORKER_PID, "args": {}},
+        {"name": "delta.wire", "cat": "flow", "ph": "t", "id": 7,
+         "ts": 4000.0, "pid": SERVER_PID, "args": {"clock": 3}},
+    ]
+    flows = decompose(events)
+    assert len(flows) == 1
+    assert flows[0]["model"] == "unknown"
+    assert flows[0]["segments"] == {"wire": pytest.approx(3.0)}
+
+
+def test_decompose_ignores_flowless_trace():
+    assert decompose([{"name": "server.apply", "ph": "X", "ts": 0.0,
+                       "dur": 5.0, "pid": 1, "args": {}}]) == []
+
+
+def test_aggregate_dominant_and_shares():
+    flows = [
+        {"model": "bsp", "segments": {"wire": 1.0, "gate_wait": 5.0}},
+        {"model": "bsp", "segments": {"wire": 2.0, "gate_wait": 7.0}},
+    ]
+    agg = aggregate(flows)
+    assert agg["flows"] == 2
+    info = agg["models"]["bsp"]
+    assert info["dominant"] == "gate_wait"
+    assert info["flows"] == 2
+    assert info["segments"]["gate_wait"]["total_ms"] == pytest.approx(12.0)
+    assert info["segments"]["wire"]["share"] == pytest.approx(3.0 / 15.0)
+    assert info["segments"]["wire"]["n"] == 2
+    assert info["segments"]["wire"]["p50_ms"] == pytest.approx(1.0)
+
+
+def test_critpath_cli_exit_codes(tmp_path, capsys):
+    good = tmp_path / "trace.json"
+    good.write_text(json.dumps({"traceEvents": _full_flow_events()}))
+    assert critpath_main(str(good)) == 0
+    out = capsys.readouterr().out
+    assert "model=sequential flows=1 dominant=gate_wait" in out
+
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"traceEvents": []}))
+    assert critpath_main(str(empty)) == 1
+    assert critpath_main(str(tmp_path / "missing.json")) == 2
+
+
+def test_rolling_critpath_diffs_windows():
+    tel = Telemetry()
+    gate = tel.histogram("gate_wait_ms", model="bsp")
+    serve = tel.histogram("serving_latency_ms")
+    crit = RollingCritpath(tel)
+
+    for _ in range(4):
+        gate.observe(50.0)
+    serve.observe(2.0)
+    r1 = crit.sample()
+    assert r1["dominant"] == "gate_wait"
+    assert r1["gate_wait_n"] == 4
+    assert r1["serving_n"] == 1
+
+    # next window: only serving traffic — the verdict must flip even
+    # though gate_wait's lifetime totals still dwarf serving's
+    for _ in range(8):
+        serve.observe(30.0)
+    r2 = crit.sample()
+    assert r2["dominant"] == "serving"
+    assert r2["serving_n"] == 8
+    assert "gate_wait_n" not in r2          # no gate traffic this window
+
+    # idle window: no observations anywhere
+    assert crit.sample()["dominant"] == "idle"
+
+
+# -- profiler ---------------------------------------------------------------
+
+def test_profiler_samples_named_thread():
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, daemon=True,
+                         name="kps-busy-obs")
+    t.start()
+    prof = SamplingProfiler(hz=100.0)
+    try:
+        for _ in range(5):
+            prof.sample_once()
+    finally:
+        stop.set()
+        t.join()
+    assert prof.samples == 5
+    text = prof.collapsed()
+    lines = [ln for ln in text.splitlines() if ln]
+    assert any(ln.startswith("kps-busy-obs;") for ln in lines)
+    # collapsed-stack interchange format: thread;frame;... count
+    for ln in lines:
+        head, _, count = ln.rpartition(" ")
+        assert head and count.isdigit()
+    # the Event.wait frame folds to threading.wait somewhere on the
+    # busy thread's stack
+    busy = next(ln for ln in lines if ln.startswith("kps-busy-obs;"))
+    assert "threading.wait" in busy
+
+
+def test_profiler_bounded_table_folds_overflow_into_other():
+    stops = [threading.Event() for _ in range(3)]
+    threads = [threading.Thread(target=s.wait, daemon=True,
+                                name=f"kps-ovf-{i}")
+               for i, s in enumerate(stops)]
+    for t in threads:
+        t.start()
+    prof = SamplingProfiler(hz=100.0, max_stacks=1)
+    try:
+        prof.sample_once()
+    finally:
+        for s in stops:
+            s.set()
+        for t in threads:
+            t.join()
+    assert prof.dropped > 0
+    assert "(other)" in prof.collapsed()
+    assert len(prof.top_stacks(1)) == 1
+
+
+# -- SLO / burn rates -------------------------------------------------------
+
+def test_count_le_interpolates_and_excludes_overflow():
+    bounds = (1.0, 2.0, 4.0)
+    counts = [4, 2, 2, 3]            # 3 in the +Inf overflow bucket
+    assert count_le(bounds, counts, 1.0) == pytest.approx(4.0)
+    # halfway into (1, 2]: 4 + 2 * 0.5
+    assert count_le(bounds, counts, 1.5) == pytest.approx(5.0)
+    assert count_le(bounds, counts, 4.0) == pytest.approx(8.0)
+    # a finite threshold never counts overflow observations
+    assert count_le(bounds, counts, 100.0) == pytest.approx(8.0)
+    assert count_le(bounds, counts, 0.0) == pytest.approx(0.0)
+
+
+def test_burn_rate_math_with_explicit_clock():
+    tel = Telemetry()
+    fr = FlightRecorder(capacity=16)
+    fr.enable(role="test")
+    plane = SLOPlane(tel, flight=fr)
+    state = {"good": 0.0, "total": 0.0}
+    plane.add(SLO("availability", 0.99,
+                  lambda: (state["good"], state["total"])))
+
+    assert plane.sample_once(now=0.0)["availability"]["fast"] == 0.0
+    # 100 events, 10 bad: bad_fraction 0.1 over a 0.01 budget -> 10x
+    state.update(good=90.0, total=100.0)
+    burns = plane.sample_once(now=10.0)
+    assert burns["availability"]["fast"] == pytest.approx(10.0)
+    assert burns["availability"]["slow"] == pytest.approx(10.0)
+    assert plane.burning()
+
+    # recovery: the next 100 events are all good — fast-window burn
+    # halves (window still spans both deltas)
+    state.update(good=190.0, total=200.0)
+    burns = plane.sample_once(now=20.0)
+    assert burns["availability"]["fast"] == pytest.approx(5.0)
+
+    d = plane.detail()["availability"]
+    assert d["target"] == 0.99
+    assert d["total"] == 200.0
+    assert d["burning"]
+    # gauges landed in the registry for /varz
+    snap = tel.snapshot()["slo_burn_rate"]
+    assert snap["slo=availability,window=fast"] == pytest.approx(5.0)
+    fr.disable()
+
+
+def test_slo_plane_beats_flight_only_while_healthy():
+    tel = Telemetry()
+    fr = FlightRecorder(capacity=16)
+    fr.enable(role="test")
+    plane = SLOPlane(tel, flight=fr)
+    state = {"good": 0.0, "total": 0.0}
+    plane.add(SLO("availability", 0.99,
+                  lambda: (state["good"], state["total"])))
+    plane.sample_once(now=0.0)
+    assert fr.last_beat("slo") is not None    # burn 0.0 -> healthy beat
+    state.update(good=0.0, total=100.0)       # everything bad
+    plane.sample_once(now=10.0)
+    assert plane.burning()
+    beat_at_burn = fr.last_beat("slo")
+    plane.sample_once(now=20.0)
+    assert fr.last_beat("slo") == beat_at_burn   # no beat while burning
+    fr.disable()
+
+
+def test_broken_reader_never_kills_the_sampler():
+    plane = SLOPlane(Telemetry(), flight=FlightRecorder(capacity=4))
+
+    def boom():
+        raise RuntimeError("reader died")
+
+    plane.add(SLO("broken", 0.99, boom))
+    assert plane.sample_once(now=1.0) == {}
+
+
+def test_slo_target_validation():
+    with pytest.raises(ValueError, match="target"):
+        SLO("bad", 1.0, lambda: (0, 0))
+
+
+def test_standard_slos_and_plane_from_args():
+    tel = Telemetry()
+    names = [s.name for s in standard_slos(tel, serving_p99_ms=50.0,
+                                           freshness_ms=2000.0)]
+    assert names == ["serving_availability", "serving_latency",
+                     "snapshot_freshness"]
+
+    assert plane_from_args(SimpleNamespace(), tel) is None
+    plane = plane_from_args(
+        SimpleNamespace(slo_serving_p99_ms=50.0, slo_freshness_ms=None),
+        tel)
+    assert plane is not None
+    assert [s.name for s in plane.slos] == ["serving_availability",
+                                            "serving_latency"]
+
+    # the latency objective reads the serving histogram: 9 fast + 1
+    # slow request -> 10% bad of a 1% budget
+    h = tel.histogram("serving_latency_ms")
+    for _ in range(9):
+        h.observe(5.0)
+    h.observe(500.0)
+    plane.sample_once(now=0.0)
+    plane.sample_once(now=10.0)
+    # no NEW traffic between the two samples -> no burn; now add bad
+    for _ in range(10):
+        h.observe(500.0)
+    burns = plane.sample_once(now=20.0)
+    assert burns["serving_latency"]["fast"] > 1.0
+
+
+# -- /profilez --------------------------------------------------------------
+
+def test_profilez_serves_collapsed_stacks():
+    fr = FlightRecorder(capacity=16)
+    fr.enable(role="test")
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, daemon=True,
+                         name="kps-gate-fixture")
+    t.start()
+    prof = SamplingProfiler(hz=100.0)
+    fr.profiler = prof
+    for _ in range(5):
+        prof.sample_once()
+    hs = HealthServer(0, flight=fr)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{hs.port}/profilez", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            body = r.read().decode()
+        assert "# samples: 5" in body
+        assert "kps-gate-fixture;" in body
+    finally:
+        hs.close()
+        stop.set()
+        t.join()
+        fr.disable()
+
+
+def test_profilez_404_when_not_armed():
+    fr = FlightRecorder(capacity=16)
+    fr.enable(role="test")
+    hs = HealthServer(0, flight=fr)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{hs.port}/profilez", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        hs.close()
+        fr.disable()

@@ -298,3 +298,35 @@ def test_capped_run_bitwise_equals_resident(tmp_path, consistency):
     base = _run(consistency)
     capped = _run(consistency, tmp_path, tier)
     assert capped.tobytes() == base.tobytes()
+
+
+def test_migrations_land_on_the_flight_timeline(tmp_path):
+    # demand faults and promote/demote migrations are the tiering
+    # events a postmortem needs on the timeline (store/tiered.py
+    # records them whenever the global FLIGHT is armed)
+    from kafka_ps_tpu.telemetry import FLIGHT, Telemetry
+    FLIGHT.enable(role="test")
+    tel = Telemetry()
+    try:
+        s, _ = _store(tmp_path, hot_pages=1, warm_pages=2, telemetry=tel)
+        victim = int(np.flatnonzero(s.residency_vector() == TIER_COLD)[-1])
+        for _ in range(32):
+            s.pin(s.page_range(victim))     # fault cold->warm, then heat
+        s.rebalance()                       # promote victim, demote old hot
+        assert s.residency_vector()[victim] == TIER_HOT
+        s.close()
+        events = FLIGHT.tail(500)
+        kinds = {e["kind"] for e in events}
+        assert {"store.fault", "store.promote", "store.demote"} <= kinds
+        fault = next(e for e in events if e["kind"] == "store.fault")
+        assert fault["pages"] >= 1 and fault["ms"] >= 0.0
+        promo = next(e for e in events if e["kind"] == "store.promote"
+                     and e["page"] == victim)
+        assert promo["tier"] == "hot"
+        # the same migrations land in the param_tier_migration_ms
+        # histogram, one observation per direction used
+        snap = tel.snapshot()["param_tier_migration_ms"]
+        assert snap["direction=promote"]["count"] >= 1
+        assert snap["direction=demote"]["count"] >= 1
+    finally:
+        FLIGHT.disable()

@@ -77,10 +77,34 @@ def test_histogram_bucket_edges_are_inclusive_upper():
     counts, total_sum, n = h.state()
     assert counts == [2, 1, 1, 1]
     assert n == 5 and total_sum == pytest.approx(0.5 + 1 + 1.0001 + 4 + 100)
-    # rank 2.5 of 5 lands in the second bucket (bucket-edge estimate)
-    assert h.quantile(0.5) == 2.0
-    # the overflow bucket reports the largest finite edge
+    # rank 2.5 of 5 lands halfway into the second bucket (1, 2]:
+    # linear interpolation gives 1 + 0.5 * (2 - 1)
+    assert h.quantile(0.5) == pytest.approx(1.5)
+    # the overflow bucket clamps to the largest finite edge
     assert h.quantile(1.0) == 4.0
+
+
+def test_histogram_quantile_interpolates_and_clamps():
+    from kafka_ps_tpu.telemetry import interp_quantile
+
+    h = Histogram(bounds=(10.0, 20.0, 40.0))
+    assert h.quantile(0.5) is None            # no observations yet
+    for _ in range(4):
+        h.observe(5.0)                        # first bucket (0, 10]
+    # rank 2 of 4 = halfway through the first bucket, whose lower
+    # edge is 0.0 by convention
+    assert h.quantile(0.5) == pytest.approx(5.0)
+    for _ in range(4):
+        h.observe(15.0)                       # second bucket (10, 20]
+    # rank 4 of 8 = exactly the first bucket's upper edge
+    assert h.quantile(0.5) == pytest.approx(10.0)
+    assert h.quantile(0.75) == pytest.approx(15.0)
+    h.observe(1e9)                            # +Inf overflow
+    assert h.quantile(1.0) == 40.0            # clamped, never inf
+    # the free function agrees with the method on the same state
+    counts, _, n = h.state()
+    assert interp_quantile((10.0, 20.0, 40.0), counts, n, 0.5) == \
+        pytest.approx(h.quantile(0.5))
 
 
 def test_clock_buckets_give_bsp_lag_zero_its_own_bucket():
