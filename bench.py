@@ -74,6 +74,8 @@ KNOWN_BLOCKS = (
     "telemetry_overhead",
     "flight_overhead",
     "profiling_overhead",
+    "modelhealth_overhead",
+    "drift_detection",
     "staleness",
 )
 
@@ -1321,6 +1323,204 @@ def profiling_overhead(iters: int = 40, trials: int = 9) -> dict:
     return out
 
 
+def modelhealth_overhead(iters: int = 60, trials: int = 9) -> dict:
+    """Model-health plane overhead gate (docs/OBSERVABILITY.md, "Model
+    health & drift"): the same serial workload with the server's
+    `modelhealth` slot holding the NULL plane (the `if .enabled:`
+    guard-only path every apply pays) vs the armed ModelHealth — delta
+    norms, cosine-vs-EWMA-direction and per-worker accounting on every
+    accepted update, the drift monitor fed per eval row, the sampler
+    thread running at its production cadence.  Trials interleaved, one
+    pair per consistency model (each exercises a different apply path).
+
+    Auditable claims: the armed plane costs < 2% server iters/s above
+    the off-vs-off2 noise floor (asserted, best-vs-best as in
+    flight_overhead — device deltas are observed BY REFERENCE and
+    resolved on the sampler thread, so the apply path pays a deque
+    append, never a host sync) and every armed arm ends
+    BITWISE-identical to its off twin under all three consistency
+    models (a diagnostics plane that perturbs the model it diagnoses
+    is worthless as a rollback trigger)."""
+    from kafka_ps_tpu.data.synth import generate_hard
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+    from kafka_ps_tpu.telemetry import Telemetry, model_name
+    from kafka_ps_tpu.telemetry.drift import DriftMonitor
+    from kafka_ps_tpu.telemetry.modelhealth import ModelHealth
+    from kafka_ps_tpu.utils.config import BufferConfig, ModelConfig, PSConfig
+
+    num_workers, cap = 4, 256
+    model = ModelConfig()
+    x, y = generate_hard(num_workers * cap, seed=29)
+
+    def build(c):
+        pcfg = PSConfig(num_workers=num_workers, consistency_model=c,
+                        model=model, eval_every=10 ** 9,
+                        buffer=BufferConfig(max_size=cap))
+        app = StreamingPSApp(pcfg)
+        for i in range(num_workers * cap):
+            app.data_sink(i % num_workers, dict(enumerate(x[i])), int(y[i]))
+        app.run_serial(max_server_iterations=4)      # compile
+        return app, {"done": 4}
+
+    out: dict = {"iters_per_trial": iters}
+    worst = 0.0
+    updates_total = 0
+    for c in (0, 2, -1):
+        apps = {"off": build(c), "off2": build(c), "on": build(c)}
+        on_app, _ = apps["on"]
+        # the plane keeps its OWN registry so the off arms stay truly
+        # bare (no telemetry plumbed through the apps at all)
+        plane = ModelHealth(Telemetry(), DriftMonitor(
+            Telemetry(), num_features=model.num_features),
+            model=model_name(c))
+        on_app.server.attach_model_health(plane)
+        counter = {"updates": 0}
+
+        def timed(key, apps=apps, plane=plane):
+            """One trial's rate; the armed arm's sampler thread runs
+            across the timed window but starts/stops OUTSIDE it
+            (arming is once-per-process, and stop()'s drain would
+            otherwise bill a full poll to every armed trial)."""
+            app, state = apps[key]
+            armed = key == "on"
+            if armed:
+                plane.start()
+            try:
+                t0 = time.perf_counter()
+                state["done"] += iters
+                app.run_serial(max_server_iterations=state["done"])
+                dt = time.perf_counter() - t0
+            finally:
+                if armed:
+                    plane.stop()        # drains the deferred deque
+            return iters / dt
+
+        for k in apps:
+            timed(k)                                # warm every arm
+        ab: dict = {k: [] for k in apps}
+        for _ in range(trials):
+            for k in apps:
+                ab[k].append(timed(k))
+        stats = {k: rate_stats(rs, round_to=2) for k, rs in ab.items()}
+        off_best, on_best = max(ab["off"]), max(ab["on"])
+        overhead = (off_best - on_best) / off_best * 100
+        floor = abs(off_best - max(ab["off2"])) / off_best * 100
+        thetas = {k: np.asarray(app.server.theta).tobytes()
+                  for k, (app, _) in apps.items()}
+        bitwise = thetas["off"] == thetas["on"] == thetas["off2"]
+        assert bitwise, \
+            f"model-health arm diverged under {model_name(c)}"
+        counter["updates"] = plane.updates
+        worst = max(worst, overhead - floor)
+        updates_total += counter["updates"]
+        out[model_name(c)] = {
+            "off_iters_per_sec": stats["off"],
+            "on_iters_per_sec": stats["on"],
+            "overhead_pct": round(overhead, 2),
+            "noise_floor_pct": round(floor, 2),
+            "theta_bitwise_identical": bitwise,
+            "updates_observed": counter["updates"],
+        }
+    assert updates_total > 0, "armed plane observed no updates"
+    out["max_overhead_pct"] = round(worst, 2)
+    assert worst < 2.0, \
+        f"model-health overhead {worst:.1f}% above noise floor >= 2%"
+    return out
+
+
+def drift_detection(chunk: int = 8, baseline_iters: int = 40,
+                    max_evals: int = 320) -> dict:
+    """Drift-detection quality gate (docs/OBSERVABILITY.md, "Model
+    health & drift"): two arms of the same streaming run with
+    eval_every=1 and the full model-health plane attached.  After a
+    calm baseline phase the INJECTED arm's input stream switches to
+    label-flipped, feature-shifted rows (data/synth.py label_noise —
+    the model keeps training on poisoned data while the held-out test
+    set stays fixed, so streaming loss rises and F1 falls); the CONTROL
+    arm keeps streaming clean rows from the same generator.
+
+    Auditable claims: the injected arm TRIPS (latched DRIFT, asserted)
+    and its detection delay in eval rows ships; the control arm ends
+    STABLE with ZERO trips (asserted — a drift alarm with false
+    positives trains operators to ignore it)."""
+    from kafka_ps_tpu.data.synth import generate
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+    from kafka_ps_tpu.telemetry import Telemetry
+    from kafka_ps_tpu.telemetry.drift import DriftMonitor
+    from kafka_ps_tpu.telemetry.modelhealth import ModelHealth
+    from kafka_ps_tpu.utils.config import BufferConfig, ModelConfig, PSConfig
+
+    num_workers, cap = 4, 256
+    model = ModelConfig()
+    n = num_workers * cap
+    # ONE draw, split: train prefill + held-out test + a second clean
+    # stretch for the control arm (same centers, fresh rows)
+    x, y = generate(2 * n + 512, model.num_features, model.num_classes,
+                    seed=31)
+    test_x, test_y = x[2 * n:], y[2 * n:]
+    cx, cy = x[n:2 * n], y[n:2 * n]
+    # the poisoned regime: labels flipped to a random other class and
+    # the feature distribution mean-shifted (covariate + concept drift)
+    dx, dy = generate(n, model.num_features, model.num_classes,
+                      seed=37, label_noise=0.95)
+    dx = dx + 1.0
+
+    def run_arm(inject: bool) -> dict:
+        pcfg = PSConfig(num_workers=num_workers, consistency_model=0,
+                        model=model, eval_every=1,
+                        buffer=BufferConfig(max_size=cap))
+        app = StreamingPSApp(pcfg, test_x=test_x, test_y=test_y)
+        mon = DriftMonitor(Telemetry(), detector="ph",
+                           num_features=model.num_features)
+        plane = ModelHealth(Telemetry(), mon)
+        app.server.attach_model_health(plane)
+        for b in app.buffers:
+            b.attach_drift(mon)
+        for i in range(n):
+            app.data_sink(i % num_workers, dict(enumerate(x[i])), int(y[i]))
+        state = {"done": 0}
+
+        def advance(iters):
+            while iters > 0:
+                step = min(chunk, iters)
+                state["done"] += step
+                app.run_serial(max_server_iterations=state["done"])
+                plane.poll()        # resolve evals -> drift monitor
+                iters -= step
+
+        advance(baseline_iters)     # detectors baseline on calm data
+        evals_at_injection = mon.evals
+        sx, sy = (dx, dy) if inject else (cx, cy)
+        for i in range(n):
+            app.data_sink(i % num_workers, dict(enumerate(sx[i])),
+                          int(sy[i]))
+        # injected: drive until the trip (or the eval budget runs out);
+        # control: a fixed 160-eval clean stretch past the same point
+        target = evals_at_injection + 160
+        while mon.evals < max_evals:
+            if inject and mon.trips > 0:
+                break
+            if not inject and mon.evals >= target:
+                break
+            advance(chunk)
+        d = mon.detail()
+        delay = (None if mon.last_trip_eval is None
+                 else mon.last_trip_eval - evals_at_injection)
+        return {**d, "evals_at_injection": evals_at_injection,
+                "delay_evals": delay}
+
+    injected = run_arm(True)
+    control = run_arm(False)
+    assert injected["trips"] >= 1 and injected["state"] == "DRIFT", \
+        f"injected drift not detected: {injected}"
+    assert control["trips"] == 0 and control["state"] == "STABLE", \
+        f"control arm false-tripped: {control}"
+    return {"detector": "ph", "injected": injected, "control": control,
+            "detected": injected["trips"] >= 1,
+            "delay_evals": injected["delay_evals"],
+            "false_trips": control["trips"]}
+
+
 def staleness_block(iters: int = 60) -> dict:
     """Consistency-model staleness distributions (docs/OBSERVABILITY.md):
     the gate-wait and vector-clock-lag histograms runtime/server.py
@@ -1681,6 +1881,8 @@ def main() -> None:
     telemetry = telemetry_overhead()
     flight = flight_overhead()
     profiling = profiling_overhead()
+    modelhealth = modelhealth_overhead()
+    drift = drift_detection()
     staleness = staleness_block()
 
     baseline = 1.85   # best aggregate worker-updates/s in reference logs
@@ -1718,6 +1920,8 @@ def main() -> None:
                 "telemetry_overhead": telemetry,
                 "flight_overhead": flight,
                 "profiling_overhead": profiling,
+                "modelhealth_overhead": modelhealth,
+                "drift_detection": drift,
                 "staleness": staleness,
             },
             "roofline": {
@@ -1801,6 +2005,13 @@ def main() -> None:
             "profiling_bitwise": all(
                 profiling[m]["theta_bitwise_identical"]
                 for m in ("sequential", "bounded", "eventual")),
+            "modelhealth_overhead_pct": modelhealth["max_overhead_pct"],
+            "modelhealth_bitwise": all(
+                modelhealth[m]["theta_bitwise_identical"]
+                for m in ("sequential", "bounded", "eventual")),
+            "drift_delay_evals": drift["delay_evals"],
+            "drift_false_trips": drift["false_trips"],
+            "drift_detected": drift["detected"],
             "gate_wait_p50_ms_sequential": staleness["sequential"][
                 "gate_wait_ms"].get("p50"),
             "clock_lag_p95_eventual": staleness["eventual"][

@@ -26,10 +26,14 @@ time; these rules catch the regressions at commit time instead:
          tiered store because its promotion/demotion plan must be a
          pure function of heat counters (docs/TIERING.md).  The derived
          observability modules (``telemetry/critpath.py``,
-         ``profiler.py``, ``slo.py``) are held to the same rule: their
-         verdicts must be pure functions of recorded timestamps and
-         registry snapshots, never of a wall clock read at analysis
-         time — the profiler's display-only wall anchor is the one
+         ``profiler.py``, ``slo.py``, ``modelhealth.py``,
+         ``drift.py``) are held to the same rule: their verdicts must
+         be pure functions of recorded timestamps, registry snapshots
+         and observation counts, never of a wall clock read at
+         analysis time — the drift detectors in particular must emit
+         the identical warn/trip sequence on a bitwise replay, which
+         is what makes them a usable rollback trigger (ROADMAP item
+         1).  The profiler's display-only wall anchor is the one
          reasoned suppression.
   PS105  blocking I/O (socket send/recv, frame send/recv, ``fsync``,
          ``time.sleep``) while holding a lock.
@@ -39,7 +43,8 @@ time; these rules catch the regressions at commit time instead:
          ``inc``, ``flow_*``) or a flight-recorder call (``record``,
          telemetry/flight.py) in ``runtime/``, ``ops/``, ``serving/``
          or the derived observability modules
-         (``telemetry/critpath.py``, ``profiler.py``, ``slo.py``) —
+         (``telemetry/critpath.py``, ``profiler.py``, ``slo.py``,
+         ``modelhealth.py``, ``drift.py``) —
          instrumentation must observe host scalars only; a metric that
          syncs the device perturbs the very latency it measures and
          breaks the telemetry-off/on bitwise contract
@@ -560,10 +565,14 @@ def _rules_for(path: Path) -> set:
             or (path.name == "range_sharded.py" and "parallel" in parts)):
         rules.add("PS104")
     if "telemetry" in parts and path.name in ("critpath.py",
-                                              "profiler.py", "slo.py"):
+                                              "profiler.py", "slo.py",
+                                              "modelhealth.py",
+                                              "drift.py"):
         # derived observability: analysis verdicts must be pure
-        # functions of recorded data (PS104), and nothing on these
-        # paths may host-sync inside an instrumentation call (PS106)
+        # functions of recorded data (PS104 — the drift detectors are
+        # replay-adjacent: same inputs, same trip sequence), and
+        # nothing on these paths may host-sync inside an
+        # instrumentation call (PS106)
         rules.add("PS104")
         rules.add("PS106")
     return rules

@@ -153,6 +153,29 @@ def build_parser(include_server_flags: bool = True,
                         "younger than MS (snapshot_age_ms histogram; "
                         "same burn-rate windows and watchdog as "
                         "--slo-serving-p99-ms)")
+    p.add_argument("--model-health", dest="model_health",
+                   action="store_true",
+                   help="arm the model-health plane (telemetry/"
+                        "modelhealth.py, docs/OBSERVABILITY.md): per-"
+                        "update delta norms + aggregate-direction "
+                        "cosine + per-worker contribution accounting, "
+                        "plus online drift detection over the streaming "
+                        "eval metrics and sampled arrivals (telemetry/"
+                        "drift.py).  Surfaces on /modelz, the [status] "
+                        "heartbeat, and a latched DRIFT ships one "
+                        "flight dump; <2%% overhead asserted by the "
+                        "modelhealth_overhead bench block")
+    p.add_argument("--drift-detector", dest="drift_detector",
+                   choices=["ph", "adwin"], default="ph",
+                   help="drift detector for --model-health: ph (Page-"
+                        "Hinkley, directional mean-shift, the default) "
+                        "or adwin (windowed adaptive cut, shift-"
+                        "direction agnostic)")
+    p.add_argument("--drift-threshold", dest="drift_threshold",
+                   type=float, default=None, metavar="T",
+                   help="detector trip threshold override (default: "
+                        "the detector's own calibration; ph "
+                        "statistic > T trips, adwin gap/bound > T)")
     p.add_argument("--device_trace", default=None, metavar="LOGDIR",
                    help="capture a jax.profiler device trace (TensorBoard "
                         "logdir) for the whole run")
@@ -381,7 +404,9 @@ def make_app_from_args(args, resuming: bool = False,
         or getattr(args, "health_port", None) is not None
         # the SLO plane judges registry families, so arming it arms them
         or getattr(args, "slo_serving_p99_ms", None) is not None
-        or getattr(args, "slo_freshness_ms", None) is not None)
+        or getattr(args, "slo_freshness_ms", None) is not None
+        # model-health diagnostics are metric families first
+        or getattr(args, "model_health", False))
     fabric = None
     if getattr(args, "durable_log", None):
         from kafka_ps_tpu.log import DurableFabric, LogConfig
@@ -637,12 +662,38 @@ def run_with_args(args) -> int:
     # flight recorder + watchdogs + health plane (docs/OBSERVABILITY.md)
     # — wired unconditionally; inert unless --flight-dir/--health-port
     from kafka_ps_tpu.telemetry.health import OpsPlane
+    from kafka_ps_tpu.telemetry.modelhealth import \
+        plane_from_args as modelhealth_from_args
+    from kafka_ps_tpu.telemetry.registry import model_name
     from kafka_ps_tpu.telemetry.slo import plane_from_args
+    # model-health plane (--model-health): the server's apply path
+    # feeds it, buffers feed its feature sketch, OpsPlane owns its
+    # sampler thread + the armed drift watchdog.  The drift CSV sink
+    # stamps wall-clock time HERE — the monitor emits clock-free rows
+    # (PS104 keeps telemetry/drift.py replay-pure).
+    drift_sink = None
+    drift_log = None
+    if getattr(args, "model_health", False) and getattr(args, "logging",
+                                                        False):
+        import time as _time
+        from kafka_ps_tpu.utils.csvlog import DRIFT_HEADER
+        drift_sink = _Sink("./logs-drift.csv", DRIFT_HEADER)
+        drift_log = (lambda rest:
+                     drift_sink(f"{int(_time.time() * 1000)};{rest}"))
+    modelhealth = modelhealth_from_args(
+        args, app.telemetry,
+        num_features=app.cfg.model.num_features,
+        model=model_name(app.cfg.consistency_model), log=drift_log)
+    if modelhealth is not None:
+        app.server.attach_model_health(modelhealth)
+        for b in app.buffers:
+            b.attach_drift(modelhealth.drift)
     ops = OpsPlane(flight_dir=getattr(args, "flight_dir", None),
                    health_port=getattr(args, "health_port", None),
                    telemetry=app.telemetry, role="run",
                    profile=getattr(args, "profile", False),
-                   slo_plane=plane_from_args(args, app.telemetry))
+                   slo_plane=plane_from_args(args, app.telemetry),
+                   modelhealth=modelhealth)
     ops.add_gate_watchdog(app.server)
     if getattr(args, "durable_log", None):
         ops.add_fsync_watchdog()
@@ -709,6 +760,10 @@ def run_with_args(args) -> int:
         app.close_logs()
         for log in logs:
             log.close()
+        if drift_sink is not None:
+            # after ops.close(): the plane's final drain may still emit
+            # a verdict row
+            drift_sink.close()
         if metrics_file:
             app.telemetry.stop_dumper()
             app.telemetry.write_prometheus(metrics_file)

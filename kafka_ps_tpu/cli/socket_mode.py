@@ -130,14 +130,17 @@ def _make_telemetry(args):
         or getattr(args, "health_port", None) is not None
         # the SLO plane judges registry families, so arming it arms them
         or getattr(args, "slo_serving_p99_ms", None) is not None
-        or getattr(args, "slo_freshness_ms", None) is not None)
+        or getattr(args, "slo_freshness_ms", None) is not None
+        # model-health diagnostics are metric families first
+        or getattr(args, "model_health", False))
     if getattr(args, "metrics_file", None) \
             and getattr(args, "metrics_every", 0.0) > 0:
         telemetry.start_dumper(args.metrics_file, args.metrics_every)
     return tracer, telemetry
 
 
-def _make_ops(args, telemetry, *, role, shard=None, meta=None):
+def _make_ops(args, telemetry, *, role, shard=None, meta=None,
+              modelhealth=None):
     """Flight recorder + watchdogs + health plane for one split-mode
     process (telemetry/health.py, docs/OBSERVABILITY.md).  Inert unless
     --flight-dir/--health-port, so every role wires it unconditionally;
@@ -151,7 +154,32 @@ def _make_ops(args, telemetry, *, role, shard=None, meta=None):
                     telemetry=telemetry, role=role, shard=shard,
                     meta=meta,
                     profile=getattr(args, "profile", False),
-                    slo_plane=plane_from_args(args, telemetry))
+                    slo_plane=plane_from_args(args, telemetry),
+                    modelhealth=modelhealth)
+
+
+def _make_modelhealth(args, telemetry, *, shard=None, num_features=None,
+                      model="sequential", log_name=None):
+    """Model-health plane for one split-mode process (--model-health,
+    telemetry/modelhealth.py) plus its wall-clock-stamping drift-CSV
+    sink — the monitor emits clock-free rows so telemetry/drift.py
+    stays replay-pure (PS104); the stamp happens here, in CLI land.
+    Returns (plane_or_None, sink_or_None); OpsPlane owns the plane's
+    lifecycle, the caller closes the sink after ops.close()."""
+    if not getattr(args, "model_health", False):
+        return None, None
+    from kafka_ps_tpu.telemetry.modelhealth import plane_from_args
+    sink = None
+    log = None
+    if getattr(args, "logging", False) and log_name:
+        from kafka_ps_tpu.utils.csvlog import CsvLogSink, DRIFT_HEADER
+        sink = CsvLogSink(log_name, DRIFT_HEADER)
+        log = (lambda rest:
+               sink(f"{int(time.time() * 1000)};{rest}"))
+    plane = plane_from_args(args, telemetry, shard=shard,
+                            num_features=num_features, model=model,
+                            log=log)
+    return plane, sink
 
 
 def _dump_telemetry(args, tracer, telemetry) -> None:
@@ -346,7 +374,20 @@ def run_server(args) -> int:
         print(f"serving predictions on port {bridge.port}",
               file=sys.stderr, flush=True)
 
-    ops = _make_ops(args, telemetry, role="server")
+    # model-health plane (--model-health): the apply path feeds it;
+    # the producer's row sink feeds its feature sketch below (in split
+    # mode the buffers live in the worker processes, but every stream
+    # row passes through HERE first)
+    from kafka_ps_tpu.telemetry.registry import model_name
+    modelhealth, drift_sink = _make_modelhealth(
+        args, telemetry, num_features=cfg.model.num_features,
+        model=model_name(cfg.consistency_model),
+        log_name="./logs-drift.csv")
+    if modelhealth is not None:
+        server.attach_model_health(modelhealth)
+
+    ops = _make_ops(args, telemetry, role="server",
+                    modelhealth=modelhealth)
     ops.add_gate_watchdog(server)
     if engine is not None:
         ops.add_serving_watchdog(engine)
@@ -390,8 +431,16 @@ def run_server(args) -> int:
         bridge, sink,
         deliverable=lambda w: (failure_policy == "rebalance"
                                or server.tracker.tracker[w].active))
+    row_sink = batch_sink
+    if modelhealth is not None:
+        def row_sink(worker: int, features, label: int) -> None:
+            # sampled feature sketch (population-stability signal,
+            # telemetry/drift.py) on the producer thread, before the
+            # row fans out to whichever worker holds the connection
+            modelhealth.drift.observe_row(features)
+            batch_sink(worker, features, label)
     producer = CsvStreamProducer(
-        args.training_data_file_path, cfg.num_workers, batch_sink,
+        args.training_data_file_path, cfg.num_workers, row_sink,
         time_per_event_ms=cfg.stream.time_per_event_ms,
         prefill_per_worker=cfg.stream.prefill_per_worker)
     producer.run_in_background()
@@ -462,6 +511,10 @@ def run_server(args) -> int:
             # per-heartbeat histogram deltas -> dominant-segment verdict
             # for this window (telemetry/critpath.py)
             out["critpath"] = rolling_critpath.sample()
+        if modelhealth is not None:
+            # model-health pulse: update norms, direction cosine,
+            # drift verdict (telemetry/modelhealth.py)
+            out["modelhealth"] = modelhealth.summary()
         return out
 
     reporter = StatusReporter(getattr(args, "status_every", 0.0) or 0.0,
@@ -502,6 +555,10 @@ def run_server(args) -> int:
         server.log.close()           # joins drain thread + closes sink
         events_log.close()
         ops.close()                  # final flight dump + health down
+        if drift_sink is not None:
+            # after ops.close(): the plane's final drain may still
+            # emit a verdict row
+            drift_sink.close()
         _dump_telemetry(args, tracer, telemetry)
     return 0
 
@@ -539,9 +596,18 @@ def run_worker(args) -> int:
         codec=_codec_spec(args),
         tracer=tracer, telemetry=telemetry)
     fabric = bridge.make_fabric()
+    # per-process model-health plane (--model-health): each worker
+    # process watches its OWN local training stream — eval rows from
+    # _finish, sampled buffer arrivals into the feature sketch
+    from kafka_ps_tpu.telemetry.registry import model_name
+    modelhealth, drift_sink = _make_modelhealth(
+        args, telemetry, num_features=cfg.model.num_features,
+        model=model_name(cfg.consistency_model),
+        log_name="./logs-drift-worker.csv")
     # death hooks armed before training: a SIGTERM'd worker leaves its
     # flight dump for the postmortem merge even mid-iteration
-    ops = _make_ops(args, telemetry, role="worker")
+    ops = _make_ops(args, telemetry, role="worker",
+                    modelhealth=modelhealth)
     ops.start()
 
     compressors = None
@@ -613,6 +679,12 @@ def run_worker(args) -> int:
     if compressors is not None:
         for w in ids:
             nodes[w].compressor = compressors[w]
+    if modelhealth is not None:
+        # all logical workers in this process share the one plane;
+        # the reader thread's buffer inserts feed the feature sketch
+        for w in ids:
+            nodes[w].modelhealth = modelhealth
+            buffers[w].attach_drift(modelhealth.drift)
 
     if state_path is not None:
         from kafka_ps_tpu.utils import checkpoint as ckpt
@@ -729,6 +801,8 @@ def run_worker(args) -> int:
     # dump BEFORE the potential os._exit below — a wedged thread must
     # not cost the process its trace/metrics/flight files
     ops.close()
+    if drift_sink is not None:
+        drift_sink.close()
     _dump_telemetry(args, tracer, telemetry)
     rc = 0
     if errors:
@@ -842,10 +916,22 @@ def run_server_shard(args) -> int:
             print(f"shard {shard_id}: durable-log replay {counts}",
                   file=sys.stderr, flush=True)
 
+    # per-shard model-health plane: every metric family carries
+    # shard=<I>, so fleet dashboards can tell WHICH slice went sour
+    from kafka_ps_tpu.telemetry.registry import model_name
+    modelhealth, drift_sink = _make_modelhealth(
+        args, telemetry, shard=shard_id,
+        num_features=cfg.model.num_features,
+        model=model_name(cfg.consistency_model),
+        log_name=f"./logs-drift-shard{shard_id}.csv")
+    if modelhealth is not None:
+        server.attach_model_health(modelhealth)
+
     # per-shard ops plane: the dump carries shard identity, so the
     # postmortem merge can tell WHICH gate in the fleet wedged
     ops = _make_ops(args, telemetry, role="server", shard=shard_id,
-                    meta={"shards": list(range(num_shards))})
+                    meta={"shards": list(range(num_shards))},
+                    modelhealth=modelhealth)
     ops.add_gate_watchdog(server)
     if getattr(inner, "durable", False):
         ops.add_fsync_watchdog()
@@ -949,6 +1035,8 @@ def run_server_shard(args) -> int:
                   f"{reroute['dropped']}, dropped sends "
                   f"{bridge.dropped_sends}", file=sys.stderr, flush=True)
         ops.close()
+        if drift_sink is not None:
+            drift_sink.close()
         _dump_telemetry(args, tracer, telemetry)
     return 0
 
@@ -996,11 +1084,19 @@ def _run_worker_sharded(args, addrs: list[str]) -> int:
     num_params = get_task(cfg.task, cfg.model).num_params
     plan = ShardPlan(num_params, len(addrs))
     tracer, telemetry = _make_telemetry(args)
+    # per-process model-health plane (--model-health): the sharded
+    # worker watches its local training stream just like run_worker
+    from kafka_ps_tpu.telemetry.registry import model_name
+    modelhealth, drift_sink = _make_modelhealth(
+        args, telemetry, num_features=cfg.model.num_features,
+        model=model_name(cfg.consistency_model),
+        log_name="./logs-drift-worker.csv")
     # meta names the FULL shard roster: the postmortem analyzer's
     # dead-shard detection is (known shards) - (shards that dumped),
     # and the worker's dump is what survives when a shard is SIGKILL'd
     ops = _make_ops(args, telemetry, role="worker",
-                    meta={"shards": list(range(len(addrs)))})
+                    meta={"shards": list(range(len(addrs)))},
+                    modelhealth=modelhealth)
     ops.start()
 
     def connect(addr: str, timeout: float = 30.0):
@@ -1070,6 +1166,9 @@ def _run_worker_sharded(args, addrs: list[str]) -> int:
         nodes[w].shard_router = routers[w]
         if compressors is not None:
             nodes[w].compressor = compressors[w]
+        if modelhealth is not None:
+            nodes[w].modelhealth = modelhealth
+            buffers[w].attach_drift(modelhealth.drift)
 
     reader_threads: list[threading.Thread] = []
 
@@ -1166,6 +1265,8 @@ def _run_worker_sharded(args, addrs: list[str]) -> int:
         if t.is_alive():
             leftover.append(t.name)
     ops.close()                  # before any os._exit: the flight dump
+    if drift_sink is not None:
+        drift_sink.close()
     _dump_telemetry(args, tracer, telemetry)
     rc = 0
     if errors:

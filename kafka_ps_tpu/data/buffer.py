@@ -79,6 +79,15 @@ class SlidingBuffer:
         # add() and snapshot() are internally synchronized so the producer
         # thread and the training loop need no external locking.
         self._lock = OrderedLock("SlidingBuffer.state")
+        # optional drift monitor (telemetry/drift.py): sampled arrivals
+        # feed its per-feature Welford sketch.  None keeps ingest
+        # byte-identical to today's path.
+        self._drift = None
+
+    def attach_drift(self, monitor) -> None:
+        """Feed sampled arrivals to a DriftMonitor's feature sketch
+        (population-stability scoring, --model-health)."""
+        self._drift = monitor
 
     # -- rate tracking (WorkerSamplingProcessor.java:124-135) --------------
 
@@ -109,6 +118,10 @@ class SlidingBuffer:
             self._add_locked(features, label)
         if self._telemetry.enabled:
             self._m_rows.inc()
+        if self._drift is not None:
+            # outside the buffer lock (lockgraph: never hold two);
+            # observe_row itself samples every Nth arrival
+            self._drift.observe_row(features)
 
     def add_many(self, rows) -> None:
         """Insert N (features, label) samples under ONE lock acquisition
@@ -117,12 +130,21 @@ class SlidingBuffer:
         calls: arrival recording and the dynamic-target eviction run
         per row, only the lock round-trips are amortized."""
         n = 0
+        # rows may be a one-shot iterable: capture features while
+        # inserting, sketch them after the lock is released (lockgraph:
+        # never hold two)
+        sampled = [] if self._drift is not None else None
         with self._lock:
             for features, label in rows:
                 self._add_locked(features, label)
                 n += 1
+                if sampled is not None:
+                    sampled.append(features)
         if n and self._telemetry.enabled:
             self._m_rows.inc(n)
+        if sampled:
+            for features in sampled:
+                self._drift.observe_row(features)
 
     def _add_locked(self, features, label: int) -> None:
         self._record_arrival()

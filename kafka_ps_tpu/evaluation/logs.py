@@ -18,6 +18,10 @@ import pandas as pd
 SERVER_COLUMNS = ["timestamp", "partition", "vectorClock", "loss",
                   "fMeasure", "accuracy"]
 WORKER_COLUMNS = SERVER_COLUMNS + ["numTuplesSeen"]
+# drift verdict log (utils/csvlog.DRIFT_HEADER, written by the CLI's
+# wall-clock-stamping sink around telemetry/drift.py): one row per
+# warn/trip edge
+DRIFT_COLUMNS = ["timestamp", "event", "detector", "statistic", "signal"]
 
 
 def _load(path: str, columns: list[str]) -> pd.DataFrame:
@@ -42,6 +46,44 @@ def load_server_log(path: str) -> pd.DataFrame:
 
 def load_worker_log(path: str) -> pd.DataFrame:
     return _load(path, WORKER_COLUMNS)
+
+
+def load_drift_log(path: str) -> pd.DataFrame:
+    """Load a `logs-drift.csv` (--model-health -l): warn/trip verdict
+    rows with numeric timestamp/statistic and derived relative seconds.
+    `event`/`detector`/`signal` stay categorical strings."""
+    df = pd.read_csv(path, sep=";")
+    missing = [c for c in DRIFT_COLUMNS if c not in df.columns]
+    if missing:
+        raise ValueError(f"{path}: missing drift columns {missing} "
+                         f"(have {list(df.columns)})")
+    df = df[DRIFT_COLUMNS].copy()
+    for c in ("timestamp", "statistic"):
+        df[c] = pd.to_numeric(df[c], errors="coerce")
+    df = df.dropna(subset=["timestamp"])
+    if len(df):
+        df["seconds"] = (df["timestamp"] - df["timestamp"].iloc[0]) / 1000.0
+    else:
+        df["seconds"] = pd.Series(dtype=float)
+    return df.reset_index(drop=True)
+
+
+def with_drift_events(server_df: pd.DataFrame,
+                      drift_df: pd.DataFrame) -> pd.DataFrame:
+    """Join the drift verdicts onto the server eval curve: adds a
+    `drift_events` column — the cumulative count of drift TRIPS at or
+    before each eval row's timestamp — so a loss/F1 plot can mark
+    where the detectors fired.  An empty drift log yields all zeros."""
+    out = server_df.copy()
+    trips = drift_df.loc[drift_df["event"] == "trip", "timestamp"]
+    trip_ts = trips.sort_values().to_numpy()
+    if len(trip_ts) == 0:
+        out["drift_events"] = 0
+        return out
+    import numpy as np
+    out["drift_events"] = np.searchsorted(
+        trip_ts, out["timestamp"].to_numpy(), side="right")
+    return out
 
 
 @dataclasses.dataclass(frozen=True)

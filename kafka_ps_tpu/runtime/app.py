@@ -337,6 +337,10 @@ class StreamingPSApp:
                 from kafka_ps_tpu.telemetry.critpath import RollingCritpath
                 self._critpath = RollingCritpath(self.telemetry)
             out["critpath"] = self._critpath.sample()
+        if self.server.modelhealth.enabled:
+            # model-health pulse (telemetry/modelhealth.py): update
+            # norms, aggregate-direction cosine, drift verdict
+            out["modelhealth"] = self.server.modelhealth.summary()
         return out
 
     def _start_status(self, status_every: float | None):

@@ -44,6 +44,7 @@ from kafka_ps_tpu.runtime.messages import (GangNotice, GradientMessage,
 from kafka_ps_tpu.telemetry import (CLOCK_BUCKETS, NULL_TELEMETRY,
                                     model_name)
 from kafka_ps_tpu.telemetry.flight import FLIGHT
+from kafka_ps_tpu.telemetry.modelhealth import NULL_MODEL_HEALTH
 from kafka_ps_tpu.utils import asynclog
 from kafka_ps_tpu.utils.config import EVENTUAL, PSConfig
 from kafka_ps_tpu.utils.trace import NULL_TRACER
@@ -199,6 +200,12 @@ class ServerNode:
         # default) keeps publish_snapshot a no-op — training is
         # bitwise-identical with serving on or off.
         self.serving = None
+        # model-health plane (telemetry/modelhealth.py): per-update
+        # diagnostics + drift detection when --model-health armed it.
+        # NULL by default — one attribute load on the hot path, and
+        # theta stays bitwise-identical either way (the plane only
+        # reads values the update already produced).
+        self.modelhealth = NULL_MODEL_HEALTH
 
     # -- tiered residency (kafka_ps_tpu/store/, docs/TIERING.md) -----------
 
@@ -238,6 +245,12 @@ class ServerNode:
         self.param_store = store
         self._theta = None           # the store owns the values now
         store.rebalance()            # settle residency under the caps
+
+    def attach_model_health(self, plane) -> None:
+        """Arm the model-health plane (telemetry/modelhealth.py): the
+        apply path starts feeding it per-update diagnostics and eval
+        metrics.  Detach by re-attaching NULL_MODEL_HEALTH."""
+        self.modelhealth = plane
 
     # -- bootstrap (ServerProcessor.java:75-87) ----------------------------
 
@@ -522,6 +535,10 @@ class ServerNode:
             self._observe_arrival(msg.worker_id, msg.vector_clock)
         if FLIGHT.enabled:
             self._flight_arrival(msg.worker_id, msg.vector_clock)
+        if self.modelhealth.enabled:
+            # host arrays (socket path) compute inline; device arrays
+            # are observed by reference and resolved off-path
+            self.modelhealth.observe_update(msg.worker_id, msg.values)
         fid = getattr(msg, "trace", None)
         self._pending_trace = fid
 
@@ -593,6 +610,10 @@ class ServerNode:
                 self.log,
                 f"{int(time.time() * 1000)};-1;{msg.vector_clock};"
                 "{};{};{}", m.loss, m.f1, m.accuracy)
+            if self.modelhealth.enabled:
+                # device futures enqueue by reference; the plane's
+                # sampler floats them off the apply path
+                self.modelhealth.observe_eval(m.loss, m.f1)
 
         self.dispatch_release_set(
             self.workers_to_respond_to(msg.vector_clock, msg.worker_id))
@@ -811,6 +832,8 @@ class ServerNode:
                 self._observe_arrival(m.worker_id, m.vector_clock)
             if FLIGHT.enabled:
                 self._flight_arrival(m.worker_id, m.vector_clock)
+            if self.modelhealth.enabled:
+                self.modelhealth.observe_update(m.worker_id, m.values)
             if (m.worker_id == 0 and self.test_x is not None
                     and m.vector_clock % self.cfg.eval_every == 0):
                 eval_positions.append(i)
@@ -867,6 +890,8 @@ class ServerNode:
                         self.log,
                         f"{int(time.time() * 1000)};-1;{m.vector_clock};"
                         "{};{};{}", met.loss, met.f1, met.accuracy)
+                    if self.modelhealth.enabled:
+                        self.modelhealth.observe_eval(met.loss, met.f1)
             rel = release_at.get(i)
             if rel:
                 theta_i = prefix_theta.get(i, final_theta)

@@ -34,7 +34,7 @@ from kafka_ps_tpu.compress import slab as slab_mod
 from kafka_ps_tpu.data.buffer import SlidingBuffer
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime.messages import GradientMessage, KeyRange, WeightsMessage
-from kafka_ps_tpu.telemetry import NULL_TELEMETRY
+from kafka_ps_tpu.telemetry import NULL_MODEL_HEALTH, NULL_TELEMETRY
 from kafka_ps_tpu.utils import asynclog
 from kafka_ps_tpu.utils.config import PSConfig
 from kafka_ps_tpu.utils.trace import NULL_TRACER
@@ -92,6 +92,10 @@ class WorkerNode:
             "worker_updates_total", worker=str(worker_id))
         self._m_update_ms = self.telemetry.histogram(
             "worker_update_ms", worker=str(worker_id))
+        # model-health plane (telemetry/modelhealth.py): in split mode
+        # each worker process runs its own plane over its local
+        # training rows — set by the CLI wiring when --model-health
+        self.modelhealth = NULL_MODEL_HEALTH
         self.worker_id = worker_id
         self.cfg = cfg
         self.fabric = fabric
@@ -207,6 +211,10 @@ class WorkerNode:
             f"{msg.vector_clock};{{}};{{}};{{}};{seen}",
             loss, f1, acc)
         self.iterations += 1
+        if self.modelhealth.enabled:
+            # device futures observed by reference; the plane's sampler
+            # thread floats them off the training path
+            self.modelhealth.observe_eval(loss, f1)
 
         encoded = None
         if self.compressor is not None:

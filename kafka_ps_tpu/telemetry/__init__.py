@@ -2,9 +2,13 @@
 registry + cross-process causal tracing glue over the utils/trace.py
 and utils/status.py backends, plus the black-box flight recorder /
 watchdog / postmortem plane (telemetry/flight.py, health.py,
-postmortem.py)."""
+postmortem.py) and the model-health/drift plane
+(telemetry/modelhealth.py, drift.py)."""
 
+from kafka_ps_tpu.telemetry.drift import DriftMonitor
 from kafka_ps_tpu.telemetry.flight import FLIGHT, FlightRecorder
+from kafka_ps_tpu.telemetry.modelhealth import (NULL_MODEL_HEALTH,
+                                                ModelHealth)
 from kafka_ps_tpu.telemetry.registry import (CLOCK_BUCKETS,
                                              LATENCY_BUCKETS_MS,
                                              NULL_TELEMETRY, Counter,
@@ -14,7 +18,7 @@ from kafka_ps_tpu.telemetry.registry import (CLOCK_BUCKETS,
                                              maybe_telemetry, model_name)
 
 __all__ = ["CLOCK_BUCKETS", "FLIGHT", "FlightRecorder",
-           "LATENCY_BUCKETS_MS", "NULL_TELEMETRY",
-           "Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "Telemetry", "interp_quantile", "maybe_telemetry",
-           "model_name"]
+           "LATENCY_BUCKETS_MS", "NULL_MODEL_HEALTH", "NULL_TELEMETRY",
+           "Counter", "DriftMonitor", "Gauge", "Histogram",
+           "MetricsRegistry", "ModelHealth", "Telemetry",
+           "interp_quantile", "maybe_telemetry", "model_name"]

@@ -29,6 +29,9 @@ The HTTP plane is stdlib-only (http.server on a named daemon thread):
     /flightz   recent flight-ring tail as JSON (?n=200)
     /profilez  collapsed-stack text from the sampling profiler
                (--profile; 404 when not armed)
+    /modelz    model-health detail — per-worker contribution/divergence
+               plus the drift verdict (--model-health; 404 when not
+               armed)
 
 `OpsPlane` bundles recorder + panel + server lifecycle for the CLI
 roles (cli/run.py, cli/socket_mode.py): construct, add watchdogs,
@@ -57,6 +60,11 @@ REPLICA_STALL_S = 30.0
 # watchdog trips a flight dump — one transiently slow batch is not an
 # incident.
 SLO_BURN_STALL_S = 60.0
+# A latched DRIFT verdict (telemetry/drift.py) is continuous demand
+# with no beat, so the armed watchdog trips — and ships the flight
+# dump — this long after the trip.  Short on purpose: the drift
+# monitor already debounced (warn level, calm decay) before latching.
+DRIFT_DUMP_S = 1.0
 
 
 class Liveness:
@@ -182,12 +190,13 @@ class HealthServer:
     scripts can scrape it, like the serving plane does)."""
 
     def __init__(self, port: int, *, panel: WatchdogPanel | None = None,
-                 flight=None, telemetry=None, slo=None,
+                 flight=None, telemetry=None, slo=None, modelhealth=None,
                  host: str = "0.0.0.0"):
         self.panel = panel
         self.flight = flight if flight is not None else FLIGHT
         self.telemetry = telemetry
         self.slo = slo                  # SLOPlane (telemetry/slo.py)
+        self.modelhealth = modelhealth  # ModelHealth (modelhealth.py)
         plane = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -250,6 +259,20 @@ class HealthServer:
                                      for k, v in sorted(stats.items()))
                     text = header + prof.collapsed() + "\n"
                     self._send(req, 200, text.encode(), "text/plain")
+            elif url.path == "/modelz":
+                plane_mh = self.modelhealth
+                if plane_mh is None or not plane_mh.enabled:
+                    self._send(req, 404,
+                               b'{"error": "model health not armed '
+                               b'(--model-health)"}',
+                               "application/json")
+                else:
+                    body = json.dumps({
+                        "role": self.flight.role,
+                        "shard": self.flight.shard,
+                        **plane_mh.detail(),
+                    }).encode()
+                    self._send(req, 200, body, "application/json")
             else:
                 self._send(req, 404, b'{"error": "unknown path"}',
                            "application/json")
@@ -281,14 +304,16 @@ class OpsPlane:
                  role: str = "run", shard: int | None = None,
                  meta: dict | None = None, flight=None,
                  profile: bool = False, profile_hz: float = 100.0,
-                 slo_plane=None):
+                 slo_plane=None, modelhealth=None):
         self.flight = flight if flight is not None else FLIGHT
         self.enabled = (flight_dir is not None or health_port is not None
-                        or profile or slo_plane is not None)
+                        or profile or slo_plane is not None
+                        or modelhealth is not None)
         self.health: HealthServer | None = None
         self.panel: WatchdogPanel | None = None
         self.profiler = None
         self.slo = None                 # SLOPlane via add_slo_plane
+        self.modelhealth = None         # ModelHealth via add_modelhealth
         self._health_port = health_port
         self._telemetry = telemetry
         if not self.enabled:
@@ -307,6 +332,8 @@ class OpsPlane:
             self.flight.profiler = self.profiler
         if slo_plane is not None:
             self.add_slo_plane(slo_plane)
+        if modelhealth is not None:
+            self.add_modelhealth(modelhealth)
 
     def add_watchdog(self, name: str, threshold_s: float, *,
                      beat_name: str | None = None,
@@ -354,6 +381,18 @@ class OpsPlane:
         self.add_watchdog("slo", threshold_s, beat_name="slo",
                           demand=slo.burning)
 
+    def add_modelhealth(self, plane,
+                        threshold_s: float = DRIFT_DUMP_S) -> None:
+        """Adopt a ModelHealth plane (telemetry/modelhealth.py):
+        surface it on /modelz, run its sampler from start(), and arm
+        the drift watchdog — a latched DRIFT is continuous demand that
+        nothing beats, so the dog trips once past `threshold_s` and
+        the panel ships the flight dump with the `drift.trip` event
+        still in the ring."""
+        self.modelhealth = plane
+        self.add_watchdog("drift", threshold_s, beat_name="drift",
+                          demand=plane.in_drift)
+
     def start(self) -> None:
         if not self.enabled:
             return
@@ -361,13 +400,16 @@ class OpsPlane:
             self.profiler.start()
         if self.slo is not None:
             self.slo.start()
+        if self.modelhealth is not None:
+            self.modelhealth.start()
         if self.panel is not None:
             self.panel.start()
         if self._health_port is not None:
             self.health = HealthServer(self._health_port, panel=self.panel,
                                        flight=self.flight,
                                        telemetry=self._telemetry,
-                                       slo=self.slo)
+                                       slo=self.slo,
+                                       modelhealth=self.modelhealth)
             print(f"health plane on port {self.health.port}",
                   file=sys.stderr, flush=True)
 
@@ -379,6 +421,10 @@ class OpsPlane:
             self.health = None
         if self.slo is not None:
             self.slo.stop()
+        if self.modelhealth is not None:
+            # stop() drains the deferred queues, so the final flight
+            # dump below sees the complete drift verdict
+            self.modelhealth.stop()
         if self.profiler is not None:
             self.profiler.stop()
         if self.panel is not None:

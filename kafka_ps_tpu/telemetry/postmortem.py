@@ -85,6 +85,7 @@ def analyze(dumps: list[dict], unreadable: list[str] | None = None) -> dict:
     last_acks: dict[int, dict] = {}     # shard -> best ack event
     trips = []
     gate_stalls = []
+    drift_events = []                   # drift.warn / drift.trip edges
 
     for d in dumps:
         role = d.get("role", "unknown")
@@ -140,6 +141,14 @@ def analyze(dumps: list[dict], unreadable: list[str] | None = None) -> dict:
                                     "worker": e.get("worker"),
                                     "clock": e.get("clock"),
                                     "lag": e.get("lag")})
+            if e.get("kind") in ("drift.warn", "drift.trip"):
+                drift_events.append({
+                    "pid": d.get("pid"), "role": role, "shard": shard,
+                    "event": e["kind"].split(".", 1)[1],
+                    "detector": e.get("detector", "?"),
+                    "signal": e.get("signal", "?"),
+                    "statistic": e.get("statistic"),
+                    "eval_row": e.get("eval_row")})
 
     dead = sorted(known_shards - present_shards)
     return {
@@ -150,6 +159,10 @@ def analyze(dumps: list[dict], unreadable: list[str] | None = None) -> dict:
         "lastAcks": {s: last_acks[s] for s in dead if s in last_acks},
         "watchdogTrips": trips,
         "gateStalls": gate_stalls[-10:],
+        # model-health verdict: did any process see the model drifting
+        # before it died?  (A trip here plus a gate stall elsewhere
+        # often means "the data changed, not the system".)
+        "driftEvents": drift_events[-10:],
         "unreadable": list(unreadable or ()),
     }
 
@@ -191,6 +204,15 @@ def format_report(report: dict) -> str:
         lines.append(f"gate evidence: pid {g['pid']} saw worker "
                      f"{g['worker']} at clock {g['clock']} "
                      f"(lag {g['lag']})")
+    for e in report.get("driftEvents", ()):
+        where = (f"shard {e['shard']}" if e["shard"] is not None
+                 else e["role"])
+        stat = (f", statistic {e['statistic']}"
+                if e.get("statistic") is not None else "")
+        row = (f" at eval row {e['eval_row']}"
+               if e.get("eval_row") is not None else "")
+        lines.append(f"drift {e['event']} on pid {e['pid']} ({where}): "
+                     f"{e['detector']} over {e['signal']}{stat}{row}")
     return "\n".join(lines)
 
 

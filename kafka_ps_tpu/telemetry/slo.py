@@ -296,29 +296,53 @@ def snapshot_freshness_slo(telemetry, bound_ms: float,
                description=f"snapshot age within {bound_ms:g}ms")
 
 
+def model_health_slo(telemetry, target: float = 0.99) -> SLO:
+    """Model-health promise: the fraction of streaming eval rows with
+    no active drift signal (telemetry/drift.py feeds both counters —
+    unhealthy = the observation carried a warn/trip level).  Burn > 1
+    at target 0.99 means more than 1% of recent eval rows saw the
+    detectors agitated — the budget starts burning at WARNING, before
+    the state machine latches DRIFT."""
+    reg = telemetry.registry
+
+    def good_total():
+        total = _sum_counters(reg, "modelhealth_evals_total")
+        bad = _sum_counters(reg, "modelhealth_unhealthy_total")
+        return total - bad, total
+
+    return SLO("model_health", target, good_total,
+               description="eval rows with no active drift signal")
+
+
 def standard_slos(telemetry, *, serving_p99_ms: float | None = None,
-                  freshness_ms: float | None = None) -> list[SLO]:
+                  freshness_ms: float | None = None,
+                  model_health: bool = False) -> list[SLO]:
     """The flag-driven objective set (cli flags --slo-serving-p99-ms /
-    --slo-freshness-ms): availability always rides along once any SLO
-    is armed."""
+    --slo-freshness-ms, plus the model_health objective once
+    --model-health armed the drift counters): availability always
+    rides along once any SLO is armed."""
     slos = [serving_availability_slo(telemetry)]
     if serving_p99_ms is not None:
         slos.append(serving_latency_slo(telemetry, serving_p99_ms))
     if freshness_ms is not None:
         slos.append(snapshot_freshness_slo(telemetry, freshness_ms))
+    if model_health:
+        slos.append(model_health_slo(telemetry))
     return slos
 
 
 def plane_from_args(args, telemetry) -> SLOPlane | None:
     """CLI seam (cli/run.py, cli/socket_mode.py:_make_ops): an armed
-    SLOPlane when any --slo-* flag was given, else None — so the ops
-    wiring can pass the result through unconditionally."""
+    SLOPlane when any --slo-* flag (or --model-health, which brings
+    its objective along) was given, else None — so the ops wiring can
+    pass the result through unconditionally."""
     p99 = getattr(args, "slo_serving_p99_ms", None)
     fresh = getattr(args, "slo_freshness_ms", None)
-    if p99 is None and fresh is None:
+    mh = bool(getattr(args, "model_health", False))
+    if p99 is None and fresh is None and not mh:
         return None
     plane = SLOPlane(telemetry)
     for slo in standard_slos(telemetry, serving_p99_ms=p99,
-                             freshness_ms=fresh):
+                             freshness_ms=fresh, model_health=mh):
         plane.add(slo)
     return plane

@@ -19,6 +19,10 @@ WORKER_HEADER = SERVER_HEADER + ";numTuplesSeen"
 # INCREMENTALLY as they happen so a crash cannot lose the record the
 # staleness auditor segments elastic runs by (evaluation/validate.py)
 EVENTS_HEADER = "timestamp;event;partition"
+# drift verdicts (telemetry/drift.py warn/trip edges): the monitor
+# emits the clock-free remainder, the CLI sink prepends the wall-clock
+# stamp (PS104: telemetry modules never read a clock)
+DRIFT_HEADER = "timestamp;event;detector;statistic;signal"
 
 
 class NullLogSink:

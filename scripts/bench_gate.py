@@ -124,6 +124,25 @@ METRICS = {
         "paths": [("detail", "paths", "profiling_overhead",
                    "max_overhead_pct"), ("profiling_overhead_pct",)],
         "direction": "lower", "cap": 2.0},
+    "modelhealth_overhead_pct": {
+        "paths": [("detail", "paths", "modelhealth_overhead",
+                   "max_overhead_pct"), ("modelhealth_overhead_pct",)],
+        "direction": "lower", "cap": 2.0},
+    # drift-detection quality: delay may not balloon past baselines
+    # (detectors count in eval rows — device-free), false trips on the
+    # clean control arm are capped at zero
+    "drift_delay_evals": {
+        "paths": [("detail", "paths", "drift_detection", "delay_evals"),
+                  ("drift_delay_evals",)],
+        "direction": "lower", "rel": 0.5, "device_free": True},
+    "drift_false_trips": {
+        "paths": [("detail", "paths", "drift_detection", "false_trips"),
+                  ("drift_false_trips",)],
+        "direction": "lower", "cap": 1.0},
+    "drift_detected": {
+        "paths": [("detail", "paths", "drift_detection", "detected"),
+                  ("drift_detected",)],
+        "must_be_true": True},
     # bitwise contracts — never degradable, never device-scoped
     "telemetry_bitwise": {
         "paths": [("detail", "paths", "telemetry_overhead",

@@ -76,6 +76,16 @@
 # postmortem` must exit 0 naming the dead shard and its last
 # acknowledged weights send (POSTMORTEM_OK).
 #
+# `scripts/tier1.sh --drift` runs the model-health smoke leg
+# (docs/OBSERVABILITY.md, "Model health & drift"): a socket-bridged
+# server + worker pair (2 logical workers) trains with --model-health
+# on a stream whose second half is label-flipped and feature-shifted —
+# the server's drift plane must latch DRIFT (observed live over
+# /modelz), the armed drift watchdog must ship a flight dump carrying
+# the drift.trip event, and the wall-clock-stamped drift CSV must
+# record the trip; a clean serial control run with the same flags must
+# finish with ZERO trip rows (DRIFT_SMOKE_OK).
+#
 # `scripts/tier1.sh --bench-gate` runs the bench regression gate
 # (scripts/bench_gate.py): the committed bench_out.json must pass
 # against the committed BENCH_r*.json baselines, and a synthetic 20%
@@ -705,6 +715,172 @@ assert "dead shard 1" in pm.stdout, pm.stdout
 assert "last ack from shard 1" in pm.stdout, pm.stdout
 print(f"POSTMORTEM_OK dumps={len(dumps)} dead_shard=1 "
       f"survivors={sorted(pids)}")
+EOF
+    exit $?
+fi
+
+if [[ "${1:-}" == "--drift" ]]; then
+    timeout -k 10 540 env JAX_PLATFORMS=cpu python - <<'EOF'
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+# a socket-bridged pair (server process + worker process hosting 2
+# logical workers) trains on a stream that TURNS: the first half of
+# train.csv is clean and learnable, the second half label-flipped AND
+# feature-shifted.  The held-out test set stays clean, so streaming
+# eval loss rises once the poisoned rows displace the clean ones in
+# the worker buffers — exactly the regime the drift plane exists for.
+root = tempfile.mkdtemp(prefix="kps-drift-")
+flight = os.path.join(root, "flight")
+repo = os.getcwd()
+rng = np.random.default_rng(0)
+N_CLEAN, N_DRIFT, N_TEST = 600, 600, 56
+xc = rng.normal(size=(N_CLEAN + N_TEST, 8)).astype(np.float32)
+yc = (xc[:, 0] > 0).astype(np.int32) + 1
+xd = (rng.normal(size=(N_DRIFT, 8)) + 2.0).astype(np.float32)
+yd = (3 - ((xd[:, 0] - 2.0 > 0).astype(np.int32) + 1)).astype(np.int32)
+
+def write_csv(path, parts):
+    with open(path, "w") as fh:
+        fh.write(",".join(f"f{i}" for i in range(8)) + ",Score\n")
+        for xx, yy in parts:
+            for r, lab in zip(xx, yy):
+                fh.write(",".join(f"{v:.6f}" for v in r) + f",{lab}\n")
+
+train = os.path.join(root, "train.csv")            # clean, then poisoned
+clean_train = os.path.join(root, "train-clean.csv")
+test = os.path.join(root, "test.csv")
+write_csv(train, [(xc[:N_CLEAN], yc[:N_CLEAN]), (xd, yd)])
+write_csv(clean_train, [(xc[:N_CLEAN], yc[:N_CLEAN])])
+write_csv(test, [(xc[N_CLEAN:], yc[N_CLEAN:])])
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+p0, hp = free_port(), free_port()
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+# the fleet is torn down once the verdict lands; MAX_IT only has to
+# outlast the ~2.5 s stream plus the detector's baseline
+MAX_IT = 100000
+common = ["--num_workers", "2", "--num_features", "8",
+          "--num_classes", "2", "--max_iterations", str(MAX_IT),
+          "--eval_every", "2", "--model-health", "--drift-detector",
+          "ph", "--flight-dir", flight]
+
+server = subprocess.Popen(
+    [sys.executable, "-m", "kafka_ps_tpu.cli.server_runner",
+     "--listen", str(p0), "-training", train, "-test", test,
+     "-p", "2", "-c", "0", "-l", "--health-port", str(hp), *common],
+    env=env, cwd=root, stderr=subprocess.PIPE,
+    stdout=subprocess.DEVNULL, text=True)
+worker = subprocess.Popen(
+    [sys.executable, "-m", "kafka_ps_tpu.cli.worker_runner",
+     "--connect", f"127.0.0.1:{p0}", "--worker_ids", "0,1",
+     "-test", test, "-min", "8", "-max", "64", *common],
+    env=env, cwd=root, stderr=subprocess.PIPE,
+    stdout=subprocess.DEVNULL, text=True)
+
+def die(msg):
+    for name, p in (("server", server), ("worker", worker)):
+        if p.poll() is None:
+            p.kill()
+        print(f"== {name} rc={p.poll()}\n{p.stderr.read()[-4000:]}",
+              file=sys.stderr)
+    raise SystemExit(msg)
+
+# watch the verdict live over /modelz until the server's plane latches
+state, doc = None, {}
+deadline = time.monotonic() + 240
+while time.monotonic() < deadline:
+    if server.poll() is not None or worker.poll() is not None:
+        die("fleet died before the drift verdict")
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{hp}/modelz", timeout=2) as r:
+            doc = json.loads(r.read())
+        state = doc["drift"]["state"]
+        if state == "DRIFT":
+            break
+    except (OSError, ValueError, KeyError):
+        pass
+    time.sleep(0.25)
+else:
+    die(f"drift never latched; last /modelz state={state}")
+assert doc["drift"]["trips"] >= 1, doc
+assert doc["updates"] > 0 and doc["workers"], doc
+
+# the armed drift watchdog (latched DRIFT = continuous demand) must
+# ship a flight dump carrying the drift.trip event within seconds
+trip_dump = None
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline and trip_dump is None:
+    for path in sorted(glob.glob(
+            os.path.join(flight, "flightdump-*.json"))):
+        try:
+            with open(path) as fh:
+                d = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if any(e.get("kind") == "drift.trip"
+               for e in d.get("events") or []):
+            trip_dump = path
+    time.sleep(0.5)
+if trip_dump is None:
+    die("no flight dump carried the drift.trip event")
+
+for p in (worker, server):
+    if p.poll() is None:
+        p.send_signal(signal.SIGTERM)
+for p in (worker, server):
+    try:
+        p.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        raise SystemExit("fleet ignored SIGTERM")
+
+# the wall-clock-stamped drift CSV recorded the trip edge
+with open(os.path.join(root, "logs-drift.csv")) as fh:
+    rows = [ln.split(";") for ln in fh.read().splitlines()[1:] if ln]
+trip_rows = [r for r in rows if r[1] == "trip"]
+assert trip_rows, f"logs-drift.csv recorded no trip: {rows}"
+
+# control: the same flags over a clean stream must end with ZERO trips
+ctl = os.path.join(root, "control")
+os.makedirs(ctl, exist_ok=True)
+proc = subprocess.run(
+    [sys.executable, "-m", "kafka_ps_tpu.cli.run",
+     "-training", clean_train, "-test", test, "-min", "8", "-max", "64",
+     "-p", "1", "-c", "0", "--mode", "serial", "-l",
+     "--num_workers", "2", "--num_features", "8", "--num_classes", "2",
+     "--eval_every", "2", "--max_iterations", "400",
+     "--model-health", "--drift-detector", "ph"],
+    env=env, cwd=ctl, capture_output=True, text=True, timeout=240)
+assert proc.returncode == 0, \
+    f"control rc={proc.returncode}\n{proc.stderr[-4000:]}"
+with open(os.path.join(ctl, "logs-drift.csv")) as fh:
+    crows = [ln.split(";") for ln in fh.read().splitlines()[1:] if ln]
+ctrips = [r for r in crows if r[1] == "trip"]
+assert not ctrips, f"control arm false-tripped: {ctrips}"
+
+print(f"DRIFT_SMOKE_OK state=DRIFT trips={doc['drift']['trips']} "
+      f"detector={doc['drift']['detector']} dump={os.path.basename(trip_dump)} "
+      f"csv_trips={len(trip_rows)} control_trips=0 "
+      f"control_events={len(crows)}")
 EOF
     exit $?
 fi

@@ -1,0 +1,9 @@
+"""PS104 positive fixture (scoped: telemetry/drift.py is a derived
+observability module): a drift verdict must be a pure function of the
+observed eval stream — a wall-clock read in the trip decision breaks
+the bitwise-replay contract that makes it a usable rollback trigger."""
+import time
+
+
+def should_trip(stat, threshold, last_trip):
+    return stat > threshold and time.time() - last_trip > 60.0

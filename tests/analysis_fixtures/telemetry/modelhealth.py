@@ -1,0 +1,13 @@
+"""PS104/PS106 negative fixture (scoped: telemetry/modelhealth.py):
+monotonic sampler pacing and metrics fed pre-fetched host scalars are
+clean even under the derived-observability rules."""
+
+import time
+
+
+def due(last, hz):
+    return time.monotonic() - last >= 1.0 / hz
+
+
+def record(hist, norm):
+    hist.observe(norm)

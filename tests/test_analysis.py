@@ -44,6 +44,7 @@ def _findings(relpath: str):
     ("runtime/ps106_flight_bad.py", "PS106"),
     ("telemetry/critpath.py", "PS104"),
     ("telemetry/slo.py", "PS106"),
+    ("telemetry/drift.py", "PS104"),
 ])
 def test_positive_fixture_triggers_exactly_once(relpath, rule):
     found = _findings(relpath)
@@ -70,6 +71,7 @@ def test_positive_fixture_triggers_exactly_once(relpath, rule):
     "runtime/ps106_ok.py",
     "runtime/ps106_flight_ok.py",
     "telemetry/profiler.py",
+    "telemetry/modelhealth.py",
 ])
 def test_negative_fixture_stays_clean(relpath):
     assert _findings(relpath) == []

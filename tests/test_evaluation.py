@@ -156,3 +156,69 @@ def test_evaluation_cli_summarize(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     data = json.loads(out.stdout)
     assert data["iterations"] == 19
+
+
+# -- drift verdict log (utils/csvlog.DRIFT_HEADER, PR 15) --------------------
+
+def _write_drift_log(path, t0=1000000):
+    from kafka_ps_tpu.utils.csvlog import DRIFT_HEADER
+    with open(path, "w") as f:
+        f.write(DRIFT_HEADER + "\n")
+        f.write(f"{t0 + 2000};warn;ph;0.9123;loss\n")
+        f.write(f"{t0 + 3500};trip;ph;1.6042;loss\n")
+        f.write(f"{t0 + 8000};trip;ph;1.7;f1\n")
+
+
+def test_load_drift_log_columns_and_types(tmp_path):
+    dp = tmp_path / "logs-drift.csv"
+    _write_drift_log(dp)
+    df = logs.load_drift_log(dp)
+    assert list(df.columns) == logs.DRIFT_COLUMNS + ["seconds"]
+    assert len(df) == 3
+    # numeric coercion on timestamp/statistic, categorical strings kept
+    assert df["statistic"].iloc[1] == pytest.approx(1.6042)
+    assert df["event"].tolist() == ["warn", "trip", "trip"]
+    assert df["detector"].iloc[0] == "ph"
+    assert df["signal"].tolist() == ["loss", "loss", "f1"]
+    # relative seconds since the first verdict row
+    assert df["seconds"].iloc[0] == pytest.approx(0.0)
+    assert df["seconds"].iloc[1] == pytest.approx(1.5)
+
+
+def test_load_drift_log_missing_columns_raises(tmp_path):
+    dp = tmp_path / "bad.csv"
+    with open(dp, "w") as f:
+        f.write("timestamp;event\n1;warn\n")
+    with pytest.raises(ValueError, match="missing drift columns"):
+        logs.load_drift_log(dp)
+
+
+def test_with_drift_events_joins_cumulative_trips(tmp_path):
+    sp = tmp_path / "logs-server.csv"
+    dp = tmp_path / "logs-drift.csv"
+    _write_server_log(sp, n=20, t0=1000000, dt_ms=500)   # ts 1000000..1009500
+    _write_drift_log(dp, t0=1000000)   # trips at +3500 and +8000 ms
+    joined = logs.with_drift_events(logs.load_server_log(sp),
+                                    logs.load_drift_log(dp))
+    assert "drift_events" in joined.columns
+    # before the first trip: 0; between trips: 1; after the second: 2
+    by_ts = dict(zip(joined["timestamp"], joined["drift_events"]))
+    assert by_ts[1000000 + 3000] == 0
+    assert by_ts[1000000 + 3500] == 1    # inclusive at the trip instant
+    assert by_ts[1000000 + 7500] == 1
+    assert by_ts[1000000 + 8000] == 2
+    assert by_ts[1000000 + 9500] == 2
+    # the warn row contributes nothing — trips only
+    assert joined["drift_events"].max() == 2
+
+
+def test_with_drift_events_empty_drift_log_is_all_zero(tmp_path):
+    sp = tmp_path / "logs-server.csv"
+    dp = tmp_path / "logs-drift.csv"
+    _write_server_log(sp, n=5)
+    from kafka_ps_tpu.utils.csvlog import DRIFT_HEADER
+    with open(dp, "w") as f:
+        f.write(DRIFT_HEADER + "\n")
+    joined = logs.with_drift_events(logs.load_server_log(sp),
+                                    logs.load_drift_log(dp))
+    assert (joined["drift_events"] == 0).all()

@@ -357,3 +357,25 @@ def test_profilez_404_when_not_armed():
     finally:
         hs.close()
         fr.disable()
+
+
+def test_rolling_critpath_empty_heartbeat_window_is_idle():
+    """Edge cases (PR 14): a critpath sampler whose registry has no
+    histogram families at all, and one whose families exist but saw
+    zero observations, both verdict "idle" — no divide-by-zero, no
+    per-segment keys fabricated from empty windows."""
+    # no families registered at all
+    bare = RollingCritpath(Telemetry())
+    assert bare.sample() == {"dominant": "idle"}
+
+    # families exist but the window (and the lifetime) are all-zero
+    tel = Telemetry()
+    tel.histogram("gate_wait_ms", model="bsp")
+    tel.histogram("serving_latency_ms")
+    crit = RollingCritpath(tel)
+    r1 = crit.sample()
+    assert r1 == {"dominant": "idle"}
+    # and again: the second window diffs two identical zero snapshots
+    r2 = crit.sample()
+    assert r2 == {"dominant": "idle"}
+    assert "gate_wait_n" not in r2 and "serving_n" not in r2

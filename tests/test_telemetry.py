@@ -369,3 +369,33 @@ def test_serving_dispatch_mode_counter_family():
     assert snap["serving_dispatch_mode"]["mode=bypass"] == s["bypasses"] == 5
     assert snap["serving_dispatch_mode"]["mode=batch"] == 3
     assert s["requests"] == 8
+
+
+def test_interp_quantile_all_zero_count_window_is_benign():
+    """Edge case (PR 14 consumers): windowed bucket-DELTA readers
+    (telemetry/slo.py, critpath.py) subtract two snapshots; an idle
+    window hands the estimator all-zero counts.  No divide-by-zero, no
+    invented values."""
+    from kafka_ps_tpu.telemetry import interp_quantile
+
+    bounds = (10.0, 20.0, 40.0)
+    zeros = [0] * (len(bounds) + 1)
+    # total 0 with zero counts: no observations -> None, every quantile
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert interp_quantile(bounds, zeros, 0, q) is None
+    # negative total (a torn snapshot pair) is treated as empty too
+    assert interp_quantile(bounds, zeros, -3, 0.5) is None
+    # degenerate family with NO finite buckets and nothing observed
+    assert interp_quantile((), [0], 0, 0.5) is None
+
+
+def test_count_le_all_zero_count_window_is_benign():
+    """The read-side dual (slo.count_le) on the same idle window: zero
+    observations <= any threshold, and interpolation inside an empty
+    bucket must not divide by its zero count."""
+    from kafka_ps_tpu.telemetry.slo import count_le
+
+    bounds = (10.0, 20.0, 40.0)
+    zeros = [0] * (len(bounds) + 1)
+    for x in (0.0, 5.0, 15.0, 40.0, 1e9):
+        assert count_le(bounds, zeros, x) == 0.0
