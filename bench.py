@@ -68,6 +68,7 @@ KNOWN_BLOCKS = (
     "serving_ab",
     "serving_load",
     "compression_ab",
+    "aggregation_ab",
     "sharding_ab",
     "slab_ab",
     "tiering_ab",
@@ -628,6 +629,221 @@ def compression_ab(iters: int = 60, warm: int = 5) -> dict:
         out[f"{key}_wire_ratio_min"] = round(min(ratios), 2)
         out[f"{key}_acc_delta_max"] = round(max(acc_deltas), 4)
     return out
+
+
+def aggregation_ab(iters: int = 24, rounds: int = 40, warm: int = 8,
+                   hosts: int = 4, sweep=(16, 32, 64)) -> dict:
+    """Hierarchical aggregation tier A/B (kafka_ps_tpu/agg/,
+    docs/AGGREGATION.md), two claims:
+
+    1. N=1 bitwise pin — one LocalAggregator in front of all workers
+       produces the byte-identical theta to the direct per-message
+       path, for all three consistency models, under --compress int8
+       (the aggregator owns the error-feedback residuals), and across
+       a SIGKILL-restart simulation (ef_state → reset → ef_restore +
+       the workers' cache resend).
+    2. Gate relief — at 16/32/64 simulated workers behind `hosts`
+       aggregators in summed mode, server messages per clock stay at
+       the host count (not the worker count) and aggregate
+       worker-updates/s scales >= 2x past the direct path's
+       4-worker plateau (the gate applies `hosts` pre-reduced adds
+       per clock instead of W per-message applies)."""
+    import dataclasses as _dc
+
+    from kafka_ps_tpu import compress as comp_mod
+    from kafka_ps_tpu.agg import LocalAggregator
+    from kafka_ps_tpu.compress import wire as cwire
+    from kafka_ps_tpu.runtime import fabric as fabric_mod
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+    from kafka_ps_tpu.runtime.messages import GradientMessage, KeyRange
+    from kafka_ps_tpu.runtime.server import ServerNode
+    from kafka_ps_tpu.utils.config import (EVENTUAL, BufferConfig,
+                                           ModelConfig, PSConfig,
+                                           StreamConfig)
+    from kafka_ps_tpu.utils.csvlog import NullLogSink
+
+    # -- part 1: the N=1 bitwise pin (small model, real worker nodes) --
+    small = ModelConfig(num_features=8, num_classes=2,
+                        local_learning_rate=0.5)
+    rng = np.random.default_rng(0)
+    centers = rng.normal(scale=2.0, size=(2, 8))
+    yd = rng.integers(0, 2, size=256)
+    xd = (centers[yd] + rng.normal(scale=0.5, size=(256, 8))).astype(
+        np.float32)
+
+    def mk_app(consistency):
+        cfg = PSConfig(num_workers=4, consistency_model=consistency,
+                       model=small,
+                       buffer=BufferConfig(min_size=8, max_size=32),
+                       stream=StreamConfig(time_per_event_ms=1.0),
+                       use_gang=False)
+        app = StreamingPSApp(cfg, test_x=xd, test_y=yd,
+                             server_log=[].append, worker_log=[].append)
+        for i in range(len(xd)):
+            app.data_sink(i % 4, {j: float(v) for j, v in
+                                  enumerate(xd[i]) if v != 0}, int(yd[i]))
+        return app
+
+    def deliver(app, delivered):
+        # worker-id order with the WeightsAssembler's stale-clock dedup
+        # — the worker-side semantics of the real --aggregate deploy
+        for worker in app.workers:
+            w = worker.worker_id
+            while True:
+                m = app.fabric.poll(fabric_mod.WEIGHTS_TOPIC, w)
+                if m is None:
+                    break
+                if m.vector_clock <= delivered.get(w, -1):
+                    continue
+                delivered[w] = m.vector_clock
+                worker.on_weights(m)
+
+    def theta_direct(consistency, compress):
+        app = mk_app(consistency)
+        if compress:
+            codec = comp_mod.get_codec(cwire.parse_codec(compress),
+                                       app.server.task.num_params)
+            app.server.compressor = comp_mod.WeightsCompressor(codec)
+            for w in app.workers:
+                w.compressor = comp_mod.ErrorFeedback(codec)
+        app.server.start_training_loop()
+        delivered: dict = {}
+        while app.server.iterations < iters:
+            deliver(app, delivered)
+            while app.server.iterations < iters:
+                g = app.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0)
+                if g is None:
+                    break
+                app.server.process(g)
+        return np.asarray(app.server.theta, np.float32).tobytes()
+
+    def theta_aggregated(consistency, compress, restart_at=None):
+        app = mk_app(consistency)
+        spec = cwire.parse_codec(compress) if compress else None
+        if spec is not None:
+            codec = comp_mod.get_codec(spec, app.server.task.num_params)
+            app.server.compressor = comp_mod.WeightsCompressor(codec)
+        agg = LocalAggregator(0, app.server.task.num_params,
+                              codec_spec=spec)
+        app.server.start_training_loop()
+        delivered: dict = {}
+        cache: dict = {}        # worker -> last delta (redelivery cache)
+        rnd = 0
+        while app.server.iterations < iters:
+            deliver(app, delivered)
+            while True:
+                g = app.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0)
+                if g is None:
+                    break
+                cache[g.worker_id] = g
+                agg.offer(g)
+            c = agg.combine()
+            if c is not None:
+                app.server.process(c)
+            rnd += 1
+            if restart_at is not None and rnd == restart_at:
+                # SIGKILL sim at a quiescent point: EF restores from
+                # the checkpoint, workers resend their caches, the
+                # clock horizon + the gate's dedup absorb the replay
+                state = agg.ef_state()
+                agg.reset()
+                agg.ef_restore(state)
+                for g in cache.values():
+                    agg.offer(_dc.replace(g))
+                dup = agg.combine()
+                if dup is not None:
+                    app.server.process(dup)
+        return np.asarray(app.server.theta, np.float32).tobytes()
+
+    n1: dict = {}
+    for name, cons in (("sequential", 0), ("bounded", 3),
+                       ("eventual", EVENTUAL)):
+        n1[name] = theta_direct(cons, None) == theta_aggregated(cons, None)
+    n1["sequential_int8"] = (theta_direct(0, "int8")
+                             == theta_aggregated(0, "int8"))
+    n1["sequential_int8_restart"] = (
+        theta_direct(0, "int8")
+        == theta_aggregated(0, "int8", restart_at=3))
+    assert all(n1.values()), f"aggregation_ab: N=1 pin broke: {n1}"
+
+    # -- part 2: gate relief at 16/32/64 workers behind `hosts` --------
+    model = ModelConfig()            # 6150 params — the reference shape
+    drng = np.random.default_rng(7)
+    x2 = drng.standard_normal((64, model.num_features)).astype(np.float32)
+    y2 = drng.integers(0, model.num_classes, size=64)
+    deltas = {}                      # one fixed delta per worker id
+
+    def delta_for(w):
+        if w not in deltas:
+            deltas[w] = (drng.standard_normal(model.num_params)
+                         .astype(np.float32) * 0.01)
+        return deltas[w]
+
+    def gate_arm(W: int, aggregate: bool) -> dict:
+        cfg = PSConfig(num_workers=W, consistency_model=0, model=model,
+                       buffer=BufferConfig(min_size=8, max_size=32),
+                       eval_every=10 ** 9, use_gang=False)
+        fabric = fabric_mod.Fabric()
+        server = ServerNode(cfg, fabric, x2, y2, NullLogSink())
+        server.start_training_loop()
+        aggs = [LocalAggregator(h, model.num_params, summed=True)
+                for h in range(hosts)]
+        t0 = msgs = None
+        for c in range(rounds):
+            if c == warm:
+                np.asarray(server.theta)      # sync before the window
+                t0, msgs = time.perf_counter(), 0
+            if aggregate:
+                for w in range(W):
+                    aggs[w % hosts].offer(GradientMessage(
+                        vector_clock=c,
+                        key_range=KeyRange(0, model.num_params),
+                        values=delta_for(w), worker_id=w))
+                for a in aggs:
+                    server.process(a.combine())
+                    if msgs is not None:
+                        msgs += 1
+            else:
+                for w in range(W):
+                    server.process(GradientMessage(
+                        vector_clock=c,
+                        key_range=KeyRange(0, model.num_params),
+                        values=delta_for(w), worker_id=w))
+                    if msgs is not None:
+                        msgs += 1
+            for w in range(W):               # drain the release fan-out
+                while fabric.poll(fabric_mod.WEIGHTS_TOPIC, w) is not None:
+                    pass
+        np.asarray(server.theta)             # sync the timing window
+        dt = time.perf_counter() - t0
+        span = rounds - warm
+        return {
+            "workers": W,
+            "server_msgs_per_clock": round(msgs / span, 2),
+            "worker_updates_per_sec": round(W * span / dt, 1),
+        }
+
+    plateau = gate_arm(hosts, aggregate=False)
+    agg_rows = [gate_arm(W, aggregate=True) for W in sweep]
+    msgs_per_clock = max(r["server_msgs_per_clock"] for r in agg_rows)
+    assert msgs_per_clock <= hosts, (
+        f"aggregation_ab: {msgs_per_clock} server msgs/clock exceeds "
+        f"the {hosts}-host bound")
+    scaling = max(r["worker_updates_per_sec"] for r in agg_rows) / max(
+        plateau["worker_updates_per_sec"], 1e-9)
+    assert scaling >= 2.0, (
+        f"aggregation_ab: updates/s scaling {scaling:.2f}x under the "
+        "2x bound vs the direct 4-worker plateau")
+
+    return {
+        "iters": iters, "rounds": rounds, "hosts": hosts,
+        "n1_bitwise": n1,
+        "all_n1_bitwise": all(n1.values()),
+        "direct_plateau": plateau,
+        "aggregated": agg_rows,
+        "msgs_per_clock_max": msgs_per_clock,
+        "updates_per_sec_scaling": round(scaling, 2),
+    }
 
 
 def sharding_ab(rounds: int = 120, warm: int = 24,
@@ -1854,6 +2070,9 @@ def main() -> None:
     # -- compressed delta transport A/B (docs/COMPRESSION.md) --------------
     compression = compression_ab()
 
+    # -- hierarchical aggregation tier A/B (docs/AGGREGATION.md) -----------
+    aggregation = aggregation_ab()
+
     # -- range-sharded server runtime A/B (docs/SHARDING.md) ---------------
     sharding = sharding_ab()
 
@@ -1914,6 +2133,7 @@ def main() -> None:
                 "serving_ab": serving,
                 "serving_load": load,
                 "compression_ab": compression,
+                "aggregation_ab": aggregation,
                 "sharding_ab": sharding,
                 "slab_ab": slab,
                 "tiering_ab": tiering,
@@ -1986,6 +2206,10 @@ def main() -> None:
             "compress_int8_acc_delta": compression["int8_acc_delta_max"],
             "compress_topk_wire_ratio": compression[
                 "topk_01_wire_ratio_min"],
+            "agg_msgs_per_clock": aggregation["msgs_per_clock_max"],
+            "agg_updates_per_sec_scaling": aggregation[
+                "updates_per_sec_scaling"],
+            "agg_n1_bitwise": aggregation["all_n1_bitwise"],
             "shard_n4_speedup": sharding["n4_speedup_best"],
             "shard_n1_bitwise": all(sharding["n1_bitwise"].values()),
             "slab_bytes_ratio_f32": slab[

@@ -13,6 +13,13 @@
 #
 #   deploy/launch_local_multihost.sh --sharded [N_SHARDS] [server args...]
 #
+# Hierarchical aggregation tier (docs/AGGREGATION.md) on one machine —
+# one server, N aggregator-relay processes, and one worker process of
+# 2 logical workers behind each relay, so the server sees N composite
+# connections instead of 2N worker connections:
+#
+#   deploy/launch_local_multihost.sh --agg [N_RELAYS] [server args...]
+#
 # Writes logs-server.csv (+ logs-worker*.csv) into $PWD.
 set -euo pipefail
 
@@ -46,6 +53,39 @@ if [ "$NPROCS" = "--sharded" ]; then
   pids+=($!)
   for p in "${pids[@]}"; do wait "$p"; done
   echo "done: $NSHARDS shards, ranges reassembled by the worker pulls"
+  exit 0
+fi
+if [ "$NPROCS" = "--agg" ]; then
+  NAGG="${1:-2}"
+  shift || true
+  export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+  if [ ! -f ./train.csv ]; then
+    python -m kafka_ps_tpu.data.synth --out_dir . --rows 2000 \
+        --test_rows 400 --hard --num_features 64
+  fi
+  NWORKERS=$(( NAGG * 2 ))
+  pids=()
+  python -m kafka_ps_tpu.cli.server_runner \
+      --listen "$PORT" -training ./train.csv -test ./test.csv \
+      --num_features 64 -c 0 --bsp-order -p 1 \
+      --num_workers "$NWORKERS" --max_iterations 200 "$@" &
+  pids+=($!)
+  for i in $(seq 0 $((NAGG - 1))); do
+    ids="$((i * 2)),$((i * 2 + 1))"
+    python -m kafka_ps_tpu.cli.agg_runner \
+        --connect "127.0.0.1:$PORT" --listen "$((PORT + 1 + i))" \
+        --agg-id "$i" --worker_ids "$ids" \
+        --num_features 64 --num_workers "$NWORKERS" &
+    pids+=($!)
+    python -m kafka_ps_tpu.cli.worker_runner \
+        --aggregate "127.0.0.1:$((PORT + 1 + i))" --worker_ids "$ids" \
+        -test ./test.csv --num_features 64 -min 8 -max 32 \
+        --num_workers "$NWORKERS" &
+    pids+=($!)
+  done
+  for p in "${pids[@]}"; do wait "$p"; done
+  echo "done: $NAGG relays pre-reduced $NWORKERS workers" \
+       "into $NAGG server connections"
   exit 0
 fi
 export KPS_PLATFORM=cpu

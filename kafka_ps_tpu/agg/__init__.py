@@ -1,0 +1,9 @@
+"""Hierarchical aggregation tier (docs/AGGREGATION.md): a per-host
+local aggregator that pre-reduces co-located workers' deltas into ONE
+composite message per (host, clock), collapsing server fan-in from
+O(workers) to O(hosts)."""
+
+from kafka_ps_tpu.agg.core import (LocalAggregator, merge_composites,
+                                   split_composite)
+
+__all__ = ["LocalAggregator", "merge_composites", "split_composite"]

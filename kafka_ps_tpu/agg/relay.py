@@ -1,0 +1,303 @@
+"""The aggregator relay process body (docs/AGGREGATION.md): one per
+host, between that host's worker processes and the server.
+
+Topology (socket deployment, cli/socket_mode.run_aggregator):
+
+    workers --TCP/shm--> AggregatorRelay --one conn--> server
+
+Upstream it is a `net.WorkerBridge` that HELLOs with `aggregator=True`
+and ALL member worker ids: the server routes the members' data rows
+and weights through this single connection and may group a release set
+into one T_WEIGHTS_AGG frame.  Downstream it is a `net.ServerBridge`
+the member workers dial exactly as they would dial a server — same
+HELLO, same CONFIG (the relay advertises the UPSTREAM run id, so
+worker-side staleness checks keep working), same framing — which is
+what lets `--aggregate HOST:PORT` reuse the sharded worker path
+unchanged (cli/socket_mode._run_worker_sharded with one address).
+
+The relay is deliberately thin and (without `--compress`) jax-free:
+
+  * gradients: members' frames decode into the downstream fabric,
+    queue in a `LocalAggregator`, and flush upstream as ONE composite
+    per (host, flush) — serialized exactly once (`send_payload`).
+  * weights: upstream frames re-broadcast raw (`forward_frame`, no
+    decode/encode cycle); a grouped T_WEIGHTS_AGG frame is expanded by
+    re-stamping the shared body's clock word per member.
+  * data rows: raw pass-through, with a per-worker stash for rows that
+    arrive before their worker has connected (the server starts
+    producing as soon as the RELAY's HELLO registers the member ids).
+
+Crash safety: the relay holds no durable protocol state — workers
+resend their redelivery caches on reconnect and the server gate
+deduplicates (docs/SHARDING.md redelivery rules).  The one exception
+is `--compress`: error-feedback residuals live here, so an optional
+checkpoint persists them AFTER each upstream send; restoring keeps the
+compressed aggregated path bitwise-pinned across a SIGKILL.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+import numpy as np
+
+from kafka_ps_tpu.agg.core import LocalAggregator
+from kafka_ps_tpu.analysis.lockgraph import OrderedLock
+from kafka_ps_tpu.compress.wire import CODEC_NONE
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime import net, serde
+from kafka_ps_tpu.runtime.net import (T_DATA, T_DATA_BATCH, T_WEIGHTS,
+                                      T_WEIGHTS_AGG)
+from kafka_ps_tpu.telemetry import FLIGHT, NULL_TELEMETRY
+from kafka_ps_tpu.utils.trace import NULL_TRACER
+
+# serde._HEADER is <4sBq>: the vector-clock word of every nested
+# weights body sits at byte offset 5 (magic + type id), for plain
+# tid-1 AND compressed tid-4 frames alike — the grouped-frame
+# expansion re-stamps it in place, touching nothing else
+_CLOCK_OFFSET = 5
+
+
+class AggregatorRelay:
+    """One host's aggregation relay: combine upstream, fan out down."""
+
+    def __init__(self, agg_id: int, upstream_host: str, upstream_port: int,
+                 worker_ids, num_params: int, *,
+                 listen_host: str = "127.0.0.1", listen_port: int = 0,
+                 codec_spec=None, summed: bool = False,
+                 checkpoint_path: str | None = None,
+                 checkpoint_every: int = 1,
+                 flush_interval: float = 0.002,
+                 heartbeat_interval: float | None = None,
+                 heartbeat_timeout: float | None = None,
+                 connect_timeout: float = 30.0,
+                 tracer=None, telemetry=None):
+        self.agg_id = agg_id
+        self.worker_ids = list(worker_ids)
+        self.flush_interval = flush_interval
+        self._tracer = tracer or NULL_TRACER
+        self._telemetry = telemetry or NULL_TELEMETRY
+        self._stop = threading.Event()
+        # upstream first: its CONFIG carries the run id the downstream
+        # listener advertises, and the negotiated codec decides whether
+        # this relay owns error-feedback state at all
+        self.upstream = net.WorkerBridge(
+            upstream_host, upstream_port, self.worker_ids,
+            connect_timeout=connect_timeout,
+            heartbeat_timeout=heartbeat_timeout,
+            codec=codec_spec, tracer=tracer, telemetry=telemetry,
+            aggregator=True)
+        spec = (self.upstream.negotiated
+                if self.upstream.negotiated.codec_id != CODEC_NONE
+                else None)
+        self.agg = LocalAggregator(agg_id, num_params, codec_spec=spec,
+                                   summed=summed, telemetry=telemetry,
+                                   tracer=tracer)
+        self._ckpt = checkpoint_path if spec is not None else None
+        self._ckpt_every = max(1, int(checkpoint_every))
+        self._flushes = 0
+        self.restored = self._restore_checkpoint()
+        # downstream: the listener the member workers dial.  No codec —
+        # members always ship raw f32 to their relay (the re-encode
+        # happens once, at the aggregator→server edge, core.py).
+        self.downstream = net.ServerBridge(
+            host=listen_host, port=listen_port,
+            run_id=self.upstream.server_run_id or 0,
+            heartbeat_interval=heartbeat_interval,
+            heartbeat_timeout=heartbeat_timeout,
+            tracer=tracer, telemetry=telemetry)
+        self.port = self.downstream.port
+        self.fabric = self.downstream.wrap(fabric_mod.Fabric())
+        # rows/weights that arrived before their worker connected: the
+        # server produces as soon as the relay's HELLO registers the
+        # member ids, which can beat the member processes to the door
+        self._stash_lock = OrderedLock("agg.stash")
+        self._stash_rows: dict[int, list] = {}
+        self._stash_weights: dict[int, bytes] = {}
+        self._m_bytes_saved = self._telemetry.counter(
+            "agg_wire_bytes_saved")
+        self.downstream.on_ready = self._on_member_ready
+        self.downstream.on_hello = self._on_member_hello
+        self.upstream.raw_forward = self._on_upstream_frame
+        self._reader = threading.Thread(
+            target=self.upstream.run_reader, args=({},), daemon=True,
+            name=f"kps-agg{agg_id}-upstream")
+        self._reader.start()
+
+    # -- downstream (member) events ----------------------------------------
+
+    def _on_member_ready(self, worker: int) -> None:
+        # READY crosses the relay verbatim: the server's bootstrap gate
+        # waits on MEMBER readiness, not relay liveness
+        self.upstream.mark_ready(worker)
+
+    def _on_member_hello(self, ids) -> None:
+        for worker in ids:
+            if worker not in self.worker_ids:
+                print(f"warning: worker {worker} connected to "
+                      f"aggregator {self.agg_id}, which does not "
+                      f"relay for it", flush=True)
+            with self._stash_lock:
+                rows = self._stash_rows.pop(worker, [])
+                weights = self._stash_weights.pop(worker, None)
+            for topic, payload in rows:
+                self.downstream.forward_frame(topic, worker, payload)
+            if weights is not None:
+                self.downstream.forward_frame(T_WEIGHTS, worker, weights)
+
+    # -- upstream (server) frames ------------------------------------------
+
+    def _on_upstream_frame(self, topic: int, key: int,
+                           payload: bytes) -> bool:
+        if topic in (T_DATA, T_DATA_BATCH):
+            self._forward_rows(topic, key, payload)
+            return True
+        if topic == T_WEIGHTS:
+            self._forward_weights(key, payload)
+            return True
+        if topic == T_WEIGHTS_AGG:
+            self._expand_group(payload)
+            return True
+        return False
+
+    def _forward_rows(self, topic: int, worker: int,
+                      payload: bytes) -> None:
+        if self.downstream.forward_frame(topic, worker, payload):
+            return
+        with self._stash_lock:
+            if worker not in self.downstream._conn_of:
+                # data rows are NOT recoverable (the producer believes
+                # they were delivered): hold them for the late joiner
+                self._stash_rows.setdefault(worker, []).append(
+                    (topic, payload))
+                return
+        self.downstream.forward_frame(topic, worker, payload)
+
+    def _forward_weights(self, worker: int, payload: bytes) -> None:
+        if self.downstream.forward_frame(T_WEIGHTS, worker, payload):
+            return
+        with self._stash_lock:
+            # weights ARE recoverable (the gate's duplicate-liveness
+            # re-send), so only the latest undeliverable frame is kept —
+            # a disconnected member's backlog must not grow unbounded
+            self._stash_weights[worker] = payload
+
+    def _expand_group(self, payload: bytes) -> None:
+        """One T_WEIGHTS_AGG frame → one T_WEIGHTS per member: the
+        shared body is re-broadcast with each member's clock stamped
+        into the serde header in place (bit-identical otherwise)."""
+        (n,) = struct.unpack_from("<q", payload, 0)
+        off = 8
+        members = []
+        for _ in range(n):
+            members.append(net._AGG_MEMBER.unpack_from(payload, off))
+            off += net._AGG_MEMBER.size
+        body = payload[off:]
+        for worker, clock in members:
+            buf = bytearray(body)
+            struct.pack_into("<q", buf, _CLOCK_OFFSET, clock)
+            self._forward_weights(worker, bytes(buf))
+        if FLIGHT.enabled:
+            FLIGHT.record("agg.forward", agg=self.agg_id,
+                          fan_out=len(members), grouped=True)
+
+    # -- the combine/flush loop --------------------------------------------
+
+    def run(self) -> None:
+        """Blocking forward loop: drain member gradients into the
+        aggregator, flush one composite upstream per full round or per
+        `flush_interval` of quiet — whichever comes first."""
+        while not self._stop.is_set():
+            if self.upstream.disconnected.is_set():
+                # the RUN is over (the server closed) — tell the members
+                # so they stop immediately; a SIGKILL'd relay never gets
+                # here, and its members instead hold the run open for the
+                # reconnect grace window (cli/socket_mode, GOODBYE_RUN_ID)
+                self.downstream.send_goodbye()
+                break
+            g = self.fabric.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0,
+                                          timeout=self.flush_interval)
+            if g is not None:
+                self.agg.offer(g)
+                if self.agg.pending_count < len(self.worker_ids):
+                    continue        # a full round may be one poll away
+            self.flush()
+
+    def flush(self) -> None:
+        comp = self.agg.combine()
+        if comp is None:
+            return
+        payload = serde.to_bytes(comp)
+        saved = self._direct_cost(comp, len(payload)) \
+            - (len(payload) + net._FRAME.size)
+        self.upstream.send_payload(0, payload)
+        if saved > 0:
+            self._m_bytes_saved.inc(saved)
+        self._flushes += 1
+        if self._ckpt and self._flushes % self._ckpt_every == 0:
+            self._save_checkpoint()
+
+    @staticmethod
+    def _direct_cost(comp, payload_len: int) -> int:
+        """Wire bytes the direct path would have spent on these
+        members: per-member serde bodies (recovered from the composite
+        length — nested bodies ride verbatim) plus one frame header
+        each.  The summed shape ships ONE body for k members, so the
+        direct cost multiplies instead."""
+        k = comp.fan_in
+        overhead = (serde._HEADER.size + serde._COMPOSITE_HEAD.size
+                    + k * (serde._MEMBER.size + serde._TRACE.size)
+                    + (1 + len(comp.deltas)) * serde._CHUNK.size)
+        bodies = payload_len - overhead
+        if comp.summed:
+            return k * (bodies + net._FRAME.size)
+        return bodies + k * net._FRAME.size
+
+    # -- EF residual checkpoint (--compress crash safety) -------------------
+
+    def _save_checkpoint(self) -> None:
+        """Persist the EF plane AFTER the upstream send, atomically:
+        a restore's horizon then only ever covers composites the server
+        has already received (core.LocalAggregator._encode)."""
+        state = self.agg.ef_state()
+        arrays = {
+            "run_id": np.asarray([self.upstream.server_run_id or 0],
+                                 dtype=np.int64),
+            "workers": np.asarray(sorted(state), dtype=np.int64),
+        }
+        for w, (residual, clock, blob) in state.items():
+            arrays[f"residual_{w}"] = residual
+            arrays[f"clock_{w}"] = np.asarray([clock], dtype=np.int64)
+            arrays[f"msg_{w}"] = np.frombuffer(blob, dtype=np.uint8)
+        tmp = self._ckpt + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, self._ckpt)
+
+    def _restore_checkpoint(self) -> bool:
+        if not self._ckpt or not os.path.exists(self._ckpt):
+            return False
+        with np.load(self._ckpt) as z:
+            if int(z["run_id"][0]) != (self.upstream.server_run_id or 0):
+                return False        # a different run's leftovers
+            state = {}
+            for w in z["workers"].tolist():
+                state[int(w)] = (z[f"residual_{w}"],
+                                 int(z[f"clock_{w}"][0]),
+                                 z[f"msg_{w}"].tobytes())
+        self.agg.ef_restore(state)
+        return True
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.downstream.close()
+        self.upstream.close()
+        if self._reader is not threading.current_thread():
+            self._reader.join(timeout=10.0)

@@ -10,13 +10,15 @@ time; these rules catch the regressions at commit time instead:
          module-level or keyed-cache site (per-message recompilation).
   PS102  host-sync calls (``.item()``, ``float()``, ``np.asarray``,
          ``np.array``, ``.block_until_ready()``) inside per-message
-         handlers in ``runtime/`` and ``serving/`` — the hot path's
-         no-host-sync property (runtime/worker.py docstring).
+         handlers in ``runtime/``, ``serving/`` and ``agg/`` — the hot
+         path's no-host-sync property (runtime/worker.py docstring);
+         the aggregation tier's combine/forward paths run once per
+         member per clock, so a sync there multiplies by fan-in.
   PS103  re-encoding in ``serde.py`` / ``net.py`` (any ``.encode(...)``
          on a non-literal receiver): messages carry verbatim
          ``encoded`` parts; int8 quantization is not idempotent.
   PS104  nondeterminism in replay-critical modules (``log/``,
-         ``compress/``, ``store/``, ``runtime/serde.py``,
+         ``compress/``, ``store/``, ``agg/``, ``runtime/serde.py``,
          ``runtime/sharding.py``, ``parallel/range_sharded.py``): wall
          clocks, ``random``, ``np.random``, ``uuid``/``urandom``, and
          iteration over a bare ``set(...)`` (hash order) — replay must
@@ -78,16 +80,16 @@ RULES: dict[str, str] = {
     "PS101": "jax.jit/pallas_call constructed outside a module-level "
              "or keyed-cache site (per-message recompilation)",
     "PS102": "host-sync call inside a per-message handler in "
-             "runtime/ or serving/",
+             "runtime/, serving/ or agg/",
     "PS103": "re-encoding in serde.py/net.py of messages that carry "
              "verbatim encoded parts",
     "PS104": "nondeterminism in a replay-critical module "
-             "(log/, compress/, store/, runtime/serde.py, the derived "
-             "observability modules in telemetry/)",
+             "(log/, compress/, store/, agg/, runtime/serde.py, the "
+             "derived observability modules in telemetry/)",
     "PS105": "blocking I/O while holding a lock",
     "PS106": "host-sync call inside the arguments of a telemetry/trace "
-             "or flight-recorder call in runtime/, ops/, serving/ or "
-             "the derived observability modules in telemetry/",
+             "or flight-recorder call in runtime/, ops/, serving/, "
+             "agg/ or the derived observability modules in telemetry/",
 }
 
 # -- rule scoping ----------------------------------------------------------
@@ -112,6 +114,12 @@ HANDLER_NAMES = frozenset({
     # serving/costmodel.py: fed from inside _dispatch/_serve — a sync
     # here would bill the cost model's own bookkeeping to the request
     "observe_dispatch", "observe_arrival", "window_s",
+    # agg/: the aggregation tier's per-delta and per-frame paths — a
+    # host sync here is charged once per member per clock, defeating
+    # the fan-in reduction the tier exists for (docs/AGGREGATION.md)
+    "combine", "_encode", "flush",
+    "_on_upstream_frame", "_forward_rows", "_forward_weights",
+    "_expand_group",
 })
 
 # PS102 host-sync markers
@@ -553,16 +561,22 @@ class _Checker(ast.NodeVisitor):
 def _rules_for(path: Path) -> set:
     parts = set(path.parts)
     rules = {"PS100", "PS101", "PS105"}
-    if "runtime" in parts or "serving" in parts:
+    if "runtime" in parts or "serving" in parts or "agg" in parts:
         rules.add("PS102")
-    if "runtime" in parts or "ops" in parts or "serving" in parts:
+    if ("runtime" in parts or "ops" in parts or "serving" in parts
+            or "agg" in parts):
         rules.add("PS106")
     if path.name in ("serde.py", "net.py"):
         rules.add("PS103")
     if ("log" in parts or "compress" in parts or "store" in parts
+            or "agg" in parts
             or (path.name == "serde.py" and "runtime" in parts)
             or (path.name == "sharding.py" and "runtime" in parts)
             or (path.name == "range_sharded.py" and "parallel" in parts)):
+        # agg/ is replay-critical end to end: combine order, the EF
+        # clock horizon and checkpoint restore must be pure functions
+        # of (worker, clock) for the N=1 bitwise pin to hold
+        # (docs/AGGREGATION.md)
         rules.add("PS104")
     if "telemetry" in parts and path.name in ("critpath.py",
                                               "profiler.py", "slo.py",

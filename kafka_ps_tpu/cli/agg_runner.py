@@ -1,0 +1,63 @@
+"""Aggregator-relay entry point (docs/AGGREGATION.md) — no analogue
+in the reference, whose broker fans every worker partition straight
+into the one server consumer; this role is what lets hundreds of
+workers fit behind one server gate by pre-reducing per host.
+
+    python -m kafka_ps_tpu.cli.agg_runner --connect hostA:8477 \\
+        --listen 8478 --agg-id 0 --worker_ids 0,1,2,3
+
+Member worker processes then dial THIS process with
+`worker_runner --aggregate host:8478`.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from kafka_ps_tpu.cli import run as run_mod
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """The aggregator-role flag surface (also validated against the
+    deployment manifests in tests/test_deploy.py)."""
+    parser = run_mod.build_parser(include_server_flags=False,
+                                  include_worker_flags=False,
+                                  prog="AggregatorRunner")
+    parser.add_argument(
+        "--connect", required=True, metavar="HOST:PORT",
+        help="the upstream server (or shard-0 server) this relay "
+             "forwards composites to; the relay HELLOs there as an "
+             "aggregator for every id in --worker_ids")
+    parser.add_argument(
+        "--listen", type=int, default=0, metavar="PORT",
+        help="downstream port the member worker processes dial "
+             "(--aggregate host:PORT); 0 = ephemeral, printed to "
+             "stderr")
+    parser.add_argument(
+        "--agg-id", dest="agg_id", type=int, default=0, metavar="I",
+        help="this relay's id — stamps composites, flight events and "
+             "metrics so a multi-host postmortem can tell relays apart")
+    parser.add_argument("--worker_ids", default="0",
+                        help="comma-separated logical worker ids this "
+                             "relay aggregates for (its member set)")
+    parser.add_argument(
+        "--summed", action="store_true",
+        help="pre-reduce single-clock flushes into ONE delta per "
+             "composite (exact by linearity under BSP, NOT bitwise-"
+             "pinned to the direct path; default stacked mode is)")
+    parser.add_argument(
+        "--flush-interval", dest="flush_interval", type=float,
+        default=0.002, metavar="SECONDS",
+        help="max quiet time before a partial round flushes upstream "
+             "(a full round — all members pending — flushes at once)")
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from kafka_ps_tpu.cli import socket_mode
+    return socket_mode.run_aggregator(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

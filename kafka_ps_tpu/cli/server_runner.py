@@ -43,6 +43,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="--shards: this process's shard index in [0, N) — shard 0 "
              "additionally hosts the stream producer")
     parser.add_argument(
+        "--bsp-order", dest="bsp_order", action="store_true",
+        help="--listen + -c 0: buffer each BSP round and apply it in "
+             "worker-id order (docs/AGGREGATION.md) — float addition "
+             "is order-sensitive, so this is the determinism knob that "
+             "makes an aggregated run bitwise-comparable to a direct "
+             "one (scripts/tier1.sh --agg)")
+    parser.add_argument(
         "--serve-replica", dest="serve_replica", action="store_true",
         help="read-replica serving process (docs/SERVING.md): follow "
              "--durable-log DIR strictly read-only and answer T_PREDICT "

@@ -317,6 +317,16 @@ def run_server(args) -> int:
     fabric = bridge.wrap(fabric_mod.Fabric())
     server = ServerNode(cfg, fabric, test_x, test_y, DeferredSink(log),
                         tracer=tracer, telemetry=telemetry)
+    # aggregation-tier hooks (kafka_ps_tpu/agg/, docs/AGGREGATION.md):
+    # releases to workers behind an aggregator relay group into one
+    # T_WEIGHTS_AGG frame per relay (no-op while no relay is connected)
+    server.weights_group_send = bridge.send_weights_group
+    if getattr(args, "bsp_order", False):
+        # deterministic BSP apply order (worker-id per round) so an
+        # aggregated run is bitwise-comparable to a direct socket run
+        server.bsp_order = True
+        print("bsp-order: buffering rounds for worker-id-ordered "
+              "applies", file=sys.stderr, flush=True)
     if codec_spec.codec_id != net.CODEC_NONE:
         # weights leave this process quantize-dequantized so both sides
         # train against the SAME decoded theta; per-connection fallback
@@ -569,7 +579,17 @@ def run_worker(args) -> int:
     `--connect` with a comma-separated address list enters the
     range-sharded deployment (docs/SHARDING.md): one connection per
     shard-server process, gradient slices routed per shard, weights
-    slices reassembled at a common clock."""
+    slices reassembled at a common clock.
+
+    `--aggregate HOST:PORT` dials a per-host aggregator relay instead
+    of the server (docs/AGGREGATION.md) and reuses the sharded path
+    with one address: the relay speaks the server protocol downstream,
+    and the router's redelivery cache is exactly the buffer-and-resend
+    a SIGKILL'd relay needs (deltas it held die with it; the stale
+    weights that follow reconnection trigger cache resends)."""
+    if getattr(args, "aggregate", None):
+        return _run_worker_sharded(args, [args.aggregate],
+                                   aggregate=True)
     if "," in args.connect:
         return _run_worker_sharded(
             args, [a for a in args.connect.split(",") if a])
@@ -725,14 +745,17 @@ def run_worker(args) -> int:
 
     # READY per worker once its buffer has data (the server gates the
     # training-loop bootstrap on this, net.ServerBridge.wait_for_workers)
+    # — or `--ready-rows N` rows of it, when a test wants training to
+    # start only after a deterministic ingestion prefix
     ready_stop = threading.Event()
+    ready_rows = max(1, int(getattr(args, "ready_rows", 1) or 1))
 
     def announce_ready():
         pending = set(ids)
         while (pending and not bridge.disconnected.is_set()
                and not ready_stop.is_set()):
             for w in list(pending):
-                if buffers[w].count > 0:
+                if buffers[w].count >= ready_rows:
                     bridge.mark_ready(w)
                     pending.discard(w)
             time.sleep(0.01)
@@ -1041,6 +1064,72 @@ def run_server_shard(args) -> int:
     return 0
 
 
+# -- hierarchical aggregation tier (kafka_ps_tpu/agg/) -----------------------
+
+def run_aggregator(args) -> int:
+    """Aggregator-relay role (docs/AGGREGATION.md): one per host,
+    between that host's worker processes and the server.
+
+        # the relay: HELLOs upstream as aggregator for workers 0-3,
+        # listens for those worker processes downstream
+        python -m kafka_ps_tpu.cli.agg_runner --connect hostA:8477 \\
+            --listen 8478 --agg-id 0 --worker_ids 0,1,2,3
+
+        # each member worker dials the RELAY, not the server
+        python -m kafka_ps_tpu.cli.worker_runner --aggregate host:8478 \\
+            --worker_ids 0 -test test.csv
+
+    The server sees ONE connection, one composite gradient frame per
+    (host, flush) and one grouped weights frame per release set —
+    fan-in collapses from O(workers) to O(hosts).  The relay holds no
+    durable protocol state (workers buffer-and-resend, the server gate
+    deduplicates); with --compress it owns the error-feedback
+    residuals, persisted via --checkpoint so a SIGKILL keeps the
+    compressed path bitwise-pinned."""
+    from kafka_ps_tpu.agg.relay import AggregatorRelay
+    from kafka_ps_tpu.models.task import get_task
+
+    connect = getattr(args, "connect", None)
+    if not connect:
+        raise SystemExit("aggregator role requires --connect HOST:PORT "
+                         "(the upstream server)")
+    host, _, port = connect.rpartition(":")
+    ids = [int(w) for w in args.worker_ids.split(",")]
+    cfg = _make_cfg(args)
+    num_params = get_task(cfg.task, cfg.model).num_params
+    tracer, telemetry = _make_telemetry(args)
+    ops = _make_ops(args, telemetry, role="aggregator")
+    ops.start()
+    spec = _codec_spec(args)
+    relay = AggregatorRelay(
+        int(getattr(args, "agg_id", 0) or 0),
+        host or "127.0.0.1", int(port), ids, num_params,
+        listen_port=int(getattr(args, "listen", 0) or 0),
+        codec_spec=spec if spec.codec_id != net.CODEC_NONE else None,
+        summed=bool(getattr(args, "summed", False)),
+        checkpoint_path=getattr(args, "checkpoint", None),
+        flush_interval=float(getattr(args, "flush_interval", 0.002)
+                             or 0.002),
+        heartbeat_interval=1.0,
+        heartbeat_timeout=getattr(args, "heartbeat_timeout", None),
+        tracer=tracer, telemetry=telemetry)
+    if relay.restored:
+        print("restored aggregator error-feedback residuals",
+              file=sys.stderr, flush=True)
+    print(f"aggregator {relay.agg_id} listening on port {relay.port} "
+          f"(members {','.join(map(str, ids))}, upstream {connect})",
+          file=sys.stderr, flush=True)
+    try:
+        relay.run()               # until the server closes the run
+    except KeyboardInterrupt:
+        pass
+    finally:
+        relay.close()
+        ops.close()
+        _dump_telemetry(args, tracer, telemetry)
+    return 0
+
+
 class _AssemblerSink:
     """Per-bridge weights sink (net.WorkerBridge.set_weights_sink):
     feeds one shard's weights slices into the shared WeightsAssembler
@@ -1057,7 +1146,8 @@ class _AssemblerSink:
             self._assembler.offer(self._shard_id, key, message)
 
 
-def _run_worker_sharded(args, addrs: list[str]) -> int:
+def _run_worker_sharded(args, addrs: list[str],
+                        aggregate: bool = False) -> int:
     """Worker role against a `--shards N` server fleet: one bridge per
     shard address (in shard-id order), a ShardRouter per logical worker
     splitting each delta into per-shard slices, and a WeightsAssembler
@@ -1068,7 +1158,15 @@ def _run_worker_sharded(args, addrs: list[str]) -> int:
     supervisor reconnects to the restarted shard process, and the
     router's redelivery cache resends the gradient slices the dead
     shard missed (bitwise — never recomputed).  The run ends when every
-    shard has closed its connection (servers reached max iterations)."""
+    shard has closed its connection (servers reached max iterations).
+
+    `aggregate=True` (--aggregate, docs/AGGREGATION.md) points the one
+    address at a per-host aggregator relay instead of a shard server.
+    Same machinery, two differences: compression is delegated (raw f32
+    to the relay, which owns the error-feedback residuals), and a
+    reconnect resends the router's WHOLE cache — the relay is
+    stateless, so unlike a checkpoint-restored shard nothing on the
+    other side knows to ask for the deltas that died with it."""
     from kafka_ps_tpu.cli.run import load_test_csv
     from kafka_ps_tpu.data.buffer import SlidingBuffer
     from kafka_ps_tpu.models.task import get_task
@@ -1142,15 +1240,24 @@ def _run_worker_sharded(args, addrs: list[str]) -> int:
     compressors = None
     spec = _codec_spec(args)
     if spec.codec_id != net.CODEC_NONE:
-        # no per-connection negotiation in the sharded fleet: slices
-        # cross the wire DECODED (dense tid-1 / sparse tid-6 frames),
-        # so --compress here is the local gradient sparsifier — topk
-        # is what makes a delta touch few shards (docs/SHARDING.md)
-        from kafka_ps_tpu import compress
-        codec = compress.get_codec(spec, num_params)
-        compressors = {w: compress.ErrorFeedback(codec) for w in ids}
-        print(f"compression: {spec.name} (local sparsifier)",
-              file=sys.stderr, flush=True)
+        if aggregate:
+            # the relay owns the error-feedback residuals and encodes
+            # ONCE at the aggregator→server edge (agg/core.py);
+            # encoding here too would quantize the signal twice
+            print(f"compression: {spec.name} (delegated to aggregator)",
+                  file=sys.stderr, flush=True)
+        else:
+            # no per-connection negotiation in the sharded fleet:
+            # slices cross the wire DECODED (dense tid-1 / sparse
+            # tid-6 frames), so --compress here is the local gradient
+            # sparsifier — topk is what makes a delta touch few shards
+            # (docs/SHARDING.md)
+            from kafka_ps_tpu import compress
+            codec = compress.get_codec(spec, num_params)
+            compressors = {w: compress.ErrorFeedback(codec)
+                           for w in ids}
+            print(f"compression: {spec.name} (local sparsifier)",
+                  file=sys.stderr, flush=True)
 
     buffers = {w: SlidingBuffer(cfg.model.num_features, cfg.buffer,
                                 telemetry=telemetry, worker=w)
@@ -1182,12 +1289,13 @@ def _run_worker_sharded(args, addrs: list[str]) -> int:
         start_reader(b)
 
     stop = threading.Event()
+    ready_rows = max(1, int(getattr(args, "ready_rows", 1) or 1))
 
     def announce_ready() -> None:
         pending = {(i, w) for i in range(len(slots)) for w in ids}
         while pending and not stop.is_set():
             for i, w in list(pending):
-                if buffers[w].count > 0:
+                if buffers[w].count >= ready_rows:
                     try:
                         slots[i].mark_ready(w)
                     except (ConnectionError, OSError):
@@ -1199,16 +1307,39 @@ def _run_worker_sharded(args, addrs: list[str]) -> int:
                                     name="kps-worker-ready")
     ready_thread.start()
 
+    # A dead aggregator relay is indistinguishable from end-of-run to
+    # its members by the socket alone: both drop their ONLY connection.
+    # They are told apart explicitly — a cleanly-closing relay sends the
+    # GOODBYE config first (net.GOODBYE_RUN_ID, agg/relay.py), a
+    # SIGKILL'd one sends nothing, so its members hold the run open for
+    # this grace window and resend their caches once the restarted relay
+    # answers.  Sharded mode keeps the simple rule: the run ends when
+    # every shard has closed (shard servers recover from their own
+    # durable logs; nothing is lost by stopping).
+    AGG_RECONNECT_GRACE = 30.0
+    down_since = [None]
+
+    def fleet_is_done() -> bool:
+        if not all(s.disconnected.is_set() for s in slots):
+            down_since[0] = None
+            return False
+        if not aggregate or any(s.run_over for s in slots):
+            return True
+        if down_since[0] is None:
+            down_since[0] = time.monotonic()
+        return time.monotonic() - down_since[0] > AGG_RECONNECT_GRACE
+
     def supervise() -> None:
-        # reconnect crashed shards; end the run when the whole fleet is
-        # gone (normal completion: every shard closes at max iterations)
+        # reconnect crashed shards/relays; end the run when the whole
+        # fleet is gone for good (normal completion: every shard closes
+        # at max iterations, a relay forwards the goodbye)
         while not stop.is_set():
+            if fleet_is_done():
+                stop.set()
+                return
             for i in range(len(slots)):
                 if not slots[i].disconnected.is_set():
                     continue
-                if all(s.disconnected.is_set() for s in slots):
-                    stop.set()
-                    return
                 try:
                     nb = connect(addrs[i], timeout=3.0)
                 except (ConnectionError, OSError):
@@ -1217,12 +1348,25 @@ def _run_worker_sharded(args, addrs: list[str]) -> int:
                 start_reader(nb)
                 slots[i] = nb
                 for w in ids:
-                    if buffers[w].count > 0:
+                    if buffers[w].count >= ready_rows:
                         try:
                             nb.mark_ready(w)
                         except (ConnectionError, OSError):
                             pass
-                print(f"reconnected to shard {i} ({addrs[i]})",
+                if aggregate:
+                    # buffer-and-resend (docs/AGGREGATION.md): the
+                    # relay is stateless, so deltas it held died with
+                    # it and NOTHING on the restarted side will ask
+                    # for them (a shard server replays its durable
+                    # log; a relay cannot).  Resend the whole cached
+                    # tail unprompted — the server deduplicates what
+                    # it already applied and its duplicate-liveness
+                    # rule re-issues any weights reply that was lost
+                    # in flight.
+                    for w in ids:
+                        routers[w].resend(i, 0)
+                print(("reconnected to aggregator" if aggregate else
+                       f"reconnected to shard {i}") + f" ({addrs[i]})",
                       file=sys.stderr, flush=True)
             time.sleep(0.2)
 

@@ -31,6 +31,18 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--worker_ids", default="0",
                         help="--connect: comma-separated logical worker "
                              "ids this process hosts")
+    parser.add_argument(
+        "--aggregate", default=None, metavar="HOST:PORT",
+        help="dial a per-host aggregator relay instead of the server "
+             "(cli/agg_runner.py, docs/AGGREGATION.md): deltas are "
+             "pre-reduced per host before the server sees them, and "
+             "compression is delegated to the relay")
+    parser.add_argument(
+        "--ready-rows", dest="ready_rows", type=int, default=1,
+        metavar="N",
+        help="rows a worker's buffer must hold before it announces "
+             "READY (default 1) — deterministic-ingestion gating for "
+             "A/B comparisons (scripts/tier1.sh --agg)")
     parser.add_argument("--state_every", type=float, default=1.0,
                         metavar="SECONDS",
                         help="--connect + --checkpoint: cadence of the "
@@ -47,7 +59,11 @@ def main(argv=None) -> int:
     args = argparse.Namespace(training_data_file_path="./data/train.csv",
                               consistency_model=0,
                               producer_time_per_event=200, **vars(args))
-    if args.connect is not None:
+    if args.connect is not None and args.aggregate is not None:
+        raise SystemExit("--connect and --aggregate are exclusive: a "
+                         "worker dials its server OR its host's "
+                         "aggregator relay, never both")
+    if args.connect is not None or args.aggregate is not None:
         if getattr(args, "durable_log", None):
             # same gate as server_runner: the split deployment's
             # durability is --checkpoint + worker-local state files

@@ -40,6 +40,18 @@ assembler has synthesized the full-range weights at the common clock.
 Server-side, gang applies coalesce per shard (each shard's
 process_batch chains its own slice applies); there is no cross-shard
 barrier in the dispatch path.
+
+Aggregation tier (kafka_ps_tpu/agg/, docs/AGGREGATION.md): a composite
+release counts as its MEMBER SET, not as one event — when the gate
+applies a CompositeDelta (or flushes a BSP round buffer) the released
+workers it unblocks form a single release set and emit ONE GangNotice
+covering every member, exactly as if the per-member deltas had arrived
+back to back; `gang.batched_members` therefore accounts fan-in
+correctly under aggregation with no special casing here.  The relay's
+grouped weights fan-out (T_WEIGHTS_AGG) is invisible to this module:
+by the time a member worker polls its weights message the relay has
+already expanded the group into per-worker frames with re-stamped
+clocks, so notice claiming matches on (worker, clock) as always.
 """
 
 from __future__ import annotations

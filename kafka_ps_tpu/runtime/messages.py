@@ -124,6 +124,67 @@ class SparseDeltaMessage:
 
 
 @dataclasses.dataclass(frozen=True)
+class CompositeDelta:
+    """aggregator → server: one pre-reduced message per (host, clock)
+    carrying the deltas of every co-located worker behind that
+    aggregator (kafka_ps_tpu/agg/, docs/AGGREGATION.md).
+
+    `members` is the vector-clock map: (worker_id, vector_clock) pairs,
+    sorted ascending and unique — the server gate advances each member
+    worker's clock from this list exactly as if the deltas had arrived
+    individually.  Two shapes share the type:
+
+      * stacked (summed=False, the default): `deltas` carries one
+        GradientMessage per member, zipped with `members`.  The server
+        expands and applies them per-member in member order, so the
+        result is BITWISE-identical to the direct (no-aggregator) path
+        for all three consistency models — float addition is not
+        associative, so exactness requires preserving the per-member
+        apply sequence, not just the sum.
+      * summed (summed=True): `deltas` is ONE GradientMessage holding
+        the pre-reduced sum over all members (exact by linearity for
+        BSP, where every member shares one clock).  One server apply
+        per host per clock — the throughput shape — documented as
+        numerically exact but not bitwise-pinned to the direct path.
+
+    Compressed transport: each member GradientMessage may carry
+    `encoded` parts produced by the AGGREGATOR's per-member
+    error-feedback residual (compress/feedback.py) — the aggregator
+    owns EF for its workers, replaying the exact encode sequence the
+    worker itself would have produced on the direct path."""
+
+    agg_id: int
+    members: tuple[tuple[int, int], ...]
+    deltas: tuple[GradientMessage, ...]
+    summed: bool = False
+
+    def __post_init__(self):
+        if not self.members:
+            raise ValueError("CompositeDelta needs at least one member")
+        if list(self.members) != sorted(set(self.members)):
+            raise ValueError("CompositeDelta members must be sorted "
+                             "and unique")
+        if self.summed:
+            if len(self.deltas) != 1:
+                raise ValueError("summed CompositeDelta carries exactly "
+                                 "one pre-reduced delta")
+        else:
+            if len(self.deltas) != len(self.members):
+                raise ValueError(
+                    f"stacked CompositeDelta carries one delta per "
+                    f"member: {len(self.deltas)} != {len(self.members)}")
+            for (w, c), d in zip(self.members, self.deltas):
+                if (d.worker_id, d.vector_clock) != (w, c):
+                    raise ValueError(
+                        f"member ({w}, {c}) does not match its delta "
+                        f"({d.worker_id}, {d.vector_clock})")
+
+    @property
+    def fan_in(self) -> int:
+        return len(self.members)
+
+
+@dataclasses.dataclass(frozen=True)
 class GangNotice:
     """Server → drive loop: the gate just released `members` (worker id,
     clock) at the same moment, and their per-worker WeightsMessages are

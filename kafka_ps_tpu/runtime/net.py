@@ -89,7 +89,7 @@ from kafka_ps_tpu.utils.trace import NULL_TRACER
 _FRAME = struct.Struct("<IBq")          # length, topic, key
 (T_WEIGHTS, T_GRADIENTS, T_DATA, T_HELLO, T_READY,
  T_PING, T_PONG, T_CONFIG, T_PREDICT, T_PREDICTION,
- T_DATA_BATCH) = range(1, 12)
+ T_DATA_BATCH, T_WEIGHTS_AGG) = range(1, 13)
 # the full frame-topic table: data topics map to their fabric names,
 # control/serving topics to wire-only names (test_net_framing.py keeps
 # this exhaustive against the T_* constants)
@@ -99,7 +99,8 @@ TOPIC_NAMES = {T_WEIGHTS: fabric_mod.WEIGHTS_TOPIC,
                T_HELLO: "hello", T_READY: "ready",
                T_PING: "ping", T_PONG: "pong", T_CONFIG: "config",
                T_PREDICT: "predict", T_PREDICTION: "prediction",
-               T_DATA_BATCH: "input-data-batch"}
+               T_DATA_BATCH: "input-data-batch",
+               T_WEIGHTS_AGG: "weights-agg"}
 
 # the optional codec trailer on HELLO and CONFIG (negotiation above)
 _CODEC_TRAILER = struct.Struct("<Bf")
@@ -116,6 +117,31 @@ _TRACE_CTX = struct.Struct("<QQ")
 # (serving/shm.py, docs/SERVING.md "Dispatch economics")
 _SHM_TRAILER = struct.Struct("<B")
 _SHM_OFFER = struct.Struct("<B16s64s")
+# the optional aggregator-role byte AFTER the shm trailer on HELLO
+# (kafka_ps_tpu/agg/, docs/AGGREGATION.md): 1 marks the connection as
+# a per-host aggregator relay.  Its registered ids are the MEMBER
+# workers behind it (weights/data route through it), its disconnect
+# does NOT evict them (the members are alive behind a restarting
+# relay; they resend through the next one), and grouped fan-out may
+# target it with ONE T_WEIGHTS_AGG frame per release.  Same
+# append-and-length-check pattern as every other trailer: plain
+# workers never send the byte and nothing changes for them.
+_AGG_TRAILER = struct.Struct("<B")
+# T_CONFIG re-sent mid-stream with this run id is a GOODBYE: the run is
+# over and the peer is closing on purpose.  An aggregator relay sends
+# it downstream before closing (agg/relay.py) so its member workers can
+# tell a finished run from a crashed relay — the latter drops the
+# members' ONLY connection exactly like end-of-run would, and without
+# this marker they could not know to hold the run open and reconnect
+# (cli/socket_mode._run_worker_sharded).  Real run ids are time_ns() or
+# checkpointed positives; -1 can never collide.
+GOODBYE_RUN_ID = -1
+# T_WEIGHTS_AGG payload: <q n> then n x <q worker><q clock>, then ONE
+# serde weights body shared by all members — the aggregator re-stamps
+# the body's vector clock per member (serde._HEADER keeps the clock at
+# byte offset 5 for plain AND compressed weights) and re-broadcasts,
+# so a k-member release costs one upstream send instead of k.
+_AGG_MEMBER = struct.Struct("<qq")
 
 # -- serving-plane payloads (kafka_ps_tpu/serving/, docs/SERVING.md) -------
 # PREDICT: the feature row plus the request's staleness bound; sentinel
@@ -248,6 +274,15 @@ def _read_shm_flag(payload, offset: int) -> bool:
     return bool(flag)
 
 
+def _read_agg_flag(payload, offset: int) -> bool:
+    """The optional <u8> aggregator-role byte after the shm trailer on
+    HELLO; False when absent (a plain worker, or any older peer)."""
+    if len(payload) < offset + _AGG_TRAILER.size:
+        return False
+    (flag,) = _AGG_TRAILER.unpack_from(payload, offset)
+    return bool(flag)
+
+
 def _read_shm_offer(payload, offset: int) -> tuple[str, bytes] | None:
     """The optional shm offer after the trace trailer on CONFIG:
     (segment name, nonce), or None when absent (legacy server) or the
@@ -375,6 +410,11 @@ class ServerBridge:
         # per connection on a HELLO that requests it, only when enabled
         # here AND a serving engine is attached
         self._shm_enabled = bool(shm)
+        # connections whose HELLO carried the aggregator-role byte
+        # (kafka_ps_tpu/agg/): weights to their member ids may group
+        # into T_WEIGHTS_AGG frames, and their disconnects are relay
+        # restarts, not member failures — on_disconnect is suppressed
+        self._agg_conns: set[socket.socket] = set()
         self._shm_of: dict[socket.socket, object] = {}
         self._shm_threads: list[threading.Thread] = []
         self._m_shm = self._telemetry.counter("serving_dispatch_mode",
@@ -457,6 +497,72 @@ class ServerBridge:
             chunks.append(struct.pack("<i", len(blob)))
             chunks.append(blob)
         return self._send_raw(conn, T_DATA_BATCH, worker, b"".join(chunks))
+
+    def send_weights_group(self, release, builder) -> set:
+        """Grouped weights fan-out for aggregator relays (the
+        ServerNode.weights_group_send hook, docs/AGGREGATION.md): ship
+        ONE T_WEIGHTS_AGG frame per relay covering every released
+        member behind it — member (worker, clock) list + one weights
+        body the relay re-stamps and re-broadcasts.  `builder(clock)`
+        produces the WeightsMessage (called once per relay; repeated
+        calls hit the server compressor's identity cache).  Returns the
+        worker ids actually shipped — members on plain connections (or
+        none at all) are left for the caller's per-worker path."""
+        groups: dict[socket.socket, list] = {}
+        for worker, clock in release:
+            conn = self._conn_of.get(worker)
+            if conn is not None and conn in self._agg_conns:
+                groups.setdefault(conn, []).append((worker, clock))
+        handled: set = set()
+        for conn, members in groups.items():
+            msg = builder(members[0][1])
+            if (getattr(msg, "encoded", None) is not None
+                    and self._codec_of.get(conn,
+                                           CODEC_SPEC_NONE).codec_id
+                    == CODEC_NONE):
+                # same downgrade rule as _send: a none-negotiated relay
+                # gets the decoded f32 body its members will train on
+                msg = dataclasses.replace(msg, encoded=None)
+            payload = b"".join(
+                [struct.pack("<q", len(members))]
+                + [_AGG_MEMBER.pack(w, c) for w, c in members]
+                + [serde.to_bytes(msg)])
+            if self._send_raw(conn, T_WEIGHTS_AGG, 0, payload):
+                handled.update(w for w, _ in members)
+        return handled
+
+    def send_goodbye(self) -> None:
+        """Announce end-of-run to every live connection (T_CONFIG with
+        GOODBYE_RUN_ID) — the relay's last act before closing its
+        downstream listener, so members stop instead of waiting out the
+        crash-reconnect grace window.  Best-effort: a connection that
+        dies mid-goodbye just pays the grace timeout."""
+        payload = struct.pack("<dq", self._hb_interval or 0.0,
+                              GOODBYE_RUN_ID)
+        for conn in list(self._send_lock):
+            self._send_raw(conn, T_CONFIG, 0, payload)
+
+    def forward_frame(self, topic: int, worker: int,
+                      payload: bytes) -> bool:
+        """Raw pre-serialized frame send to the connection owning
+        `worker` — the aggregator relay's downstream re-broadcast path
+        (weights with a re-stamped clock, pass-through data rows): the
+        bytes cross without a decode/encode cycle, so what the worker
+        receives is bit-identical to what the server sent.  A weights
+        frame to a trace-negotiated member gets a FRESH flow suffix —
+        the member's reader strips 16 bytes unconditionally, and the
+        upstream hop's suffix never crossed the relay."""
+        conn = self._conn_of.get(worker)
+        if conn is None:
+            return False
+        if topic == T_WEIGHTS and self._trace_of.get(conn):
+            fid = self._tracer.new_flow_id()
+            with self._tracer.span("net.send", topic="weights",
+                                   worker=worker):
+                self._tracer.flow_start("weights.wire", fid,
+                                        worker=worker)
+            payload += _TRACE_CTX.pack(fid, 0)
+        return self._send_raw(conn, topic, worker, payload)
 
     def wait_for_connected(self, workers, timeout: float = 60.0) -> None:
         """Block until every worker id has a connection (HELLO seen) —
@@ -645,6 +751,11 @@ class ServerBridge:
                     # shm negotiation: the offer rides CONFIG only when
                     # the peer asked — worker handshakes stay
                     # byte-identical to every earlier version
+                    if _read_agg_flag(payload, 8 + 8 * n
+                                      + _CODEC_TRAILER.size
+                                      + _TRACE_TRAILER.size
+                                      + _SHM_TRAILER.size):
+                        self._agg_conns.add(conn)
                     shm_tail = b""
                     if _read_shm_flag(payload, 8 + 8 * n
                                       + _CODEC_TRAILER.size
@@ -823,6 +934,8 @@ class ServerBridge:
             for w in ids:
                 del self._conn_of[w]
                 self._ready.discard(w)
+            was_agg = conn in self._agg_conns
+            self._agg_conns.discard(conn)
             self._send_lock.pop(conn, None)
             self._last_recv.pop(conn, None)
             self._codec_of.pop(conn, None)
@@ -832,7 +945,14 @@ class ServerBridge:
         if chan is not None:
             chan.close()    # wakes + ends the kps-shm-serve thread
         if FLIGHT.enabled and ids:
-            FLIGHT.record("net.disconnect", workers=ids)
+            FLIGHT.record("net.disconnect", workers=ids, agg=was_agg)
+        if was_agg:
+            # an aggregator relay died, not its member workers: the
+            # members are alive behind it, buffering resends for the
+            # restarted relay — evicting them would shrink the gate on
+            # a transient.  Their registrations are purged above; a
+            # re-HELLO from the restarted relay re-registers them.
+            return
         if ids and not self._stop.is_set() and self.on_disconnect is not None:
             self.on_disconnect(ids)
 
@@ -847,7 +967,8 @@ class WorkerBridge:
                  connect_timeout: float = 30.0,
                  heartbeat_timeout: float | None = None,
                  codec: CodecSpec | None = None,
-                 tracer=None, telemetry=None):
+                 tracer=None, telemetry=None,
+                 aggregator: bool = False):
         """`heartbeat_timeout`: seconds of total server silence before
         the connection is declared dead (only sensible when the server
         PINGs, i.e. it was built with a heartbeat_interval — otherwise a
@@ -858,8 +979,20 @@ class WorkerBridge:
         builds its gradient compressors from THAT, not the flag.
         `tracer`: offering tracer — when it is on AND the server answers
         the offer, `self.trace_negotiated` goes True and WEIGHTS /
-        GRADIENTS frames carry the 16-byte trace context."""
+        GRADIENTS frames carry the 16-byte trace context.
+        `aggregator`: HELLO as a per-host aggregation relay for
+        `worker_ids` (the MEMBER workers behind it, docs/AGGREGATION
+        .md): the server routes their weights/data through this
+        connection, may group releases into T_WEIGHTS_AGG frames, and
+        treats a disconnect as a relay restart instead of a member
+        failure."""
         self.worker_ids = list(worker_ids)
+        self.aggregator = bool(aggregator)
+        # relay hook (agg/relay.py): when set, run_reader hands raw
+        # pass-through frames (data rows, per-worker weights, grouped
+        # weights) to it BEFORE any decode; a True return consumes the
+        # frame.  None keeps the classic worker-process dispatch.
+        self.raw_forward = None
         self._heartbeat_timeout = heartbeat_timeout
         self.codec = codec if codec is not None else CODEC_SPEC_NONE
         self.negotiated = CODEC_SPEC_NONE
@@ -885,12 +1018,20 @@ class WorkerBridge:
         self._send_lock = OrderedLock("WorkerBridge.send")
         self._stop = threading.Event()
         self.disconnected = threading.Event()
+        # set by a mid-stream GOODBYE config: the run ended cleanly,
+        # the EOF that follows is not a crash (read before
+        # `disconnected` by the aggregated worker supervisor)
+        self.run_over = False
         self.server_run_id: int | None = None
         payload = (struct.pack(f"<q{len(self.worker_ids)}q",
                                len(self.worker_ids), *self.worker_ids)
                    + _CODEC_TRAILER.pack(self.codec.codec_id,
                                          self.codec.param)
                    + _TRACE_TRAILER.pack(int(self._tracer.enabled)))
+        if self.aggregator:
+            # trailers are positional: the agg byte sits after the shm
+            # slot, so an explicit not-requesting-shm byte fills it
+            payload += _SHM_TRAILER.pack(0) + _AGG_TRAILER.pack(1)
         locked_send(self._sock, self._send_lock, T_HELLO, 0, payload)
         # synchronous handshake: the server replies T_CONFIG before it
         # registers our ids (net.ServerBridge._reader), so it is the
@@ -963,6 +1104,33 @@ class WorkerBridge:
             FLIGHT.record("net.send", topic="gradients",
                           worker=getattr(message, "worker_id", key),
                           clock=getattr(message, "vector_clock", -1),
+                          bytes=len(payload))
+
+    def send_payload(self, key: int, payload: bytes) -> None:
+        """Ship one PRE-serialized gradient-topic frame — the relay's
+        composite send (agg/relay.py), which serializes the composite
+        exactly once for both the wire-bytes accounting and the send.
+        The trace suffix is mandatory when negotiated: the server's
+        reader strips 16 bytes from every T_GRADIENTS frame on a
+        trace-negotiated connection, composite or not."""
+        if self.trace_negotiated:
+            fid = self._tracer.new_flow_id()
+            with self._tracer.span("net.send", topic="gradients",
+                                   worker=key):
+                self._tracer.flow_start("delta.wire", fid)
+            payload += _TRACE_CTX.pack(fid, 0)
+        locked_send(self._sock, self._send_lock,
+                    T_GRADIENTS, key, payload)
+        with self._wire_lock:
+            self.wire_bytes[T_GRADIENTS] = (
+                self.wire_bytes.get(T_GRADIENTS, 0)
+                + _FRAME.size + len(payload))
+        if self._telemetry.enabled:
+            frames, nbytes = self._m_sent[T_GRADIENTS]
+            frames.inc()
+            nbytes.inc(_FRAME.size + len(payload))
+        if FLIGHT.enabled:
+            FLIGHT.record("net.send", topic="gradients", worker=key,
                           bytes=len(payload))
 
     def make_fabric(self) -> fabric_mod.Fabric:
@@ -1043,10 +1211,36 @@ class WorkerBridge:
                 if topic == T_CONFIG:
                     # normally consumed by the constructor handshake;
                     # tolerate a re-sent config mid-stream (same <dq>
-                    # decode — run id changes are not acted on)
-                    (interval, _rid) = struct.unpack_from("<dq", payload, 0)
+                    # decode — run id changes are not acted on, except
+                    # the GOODBYE sentinel announcing a clean end-of-run
+                    (interval, rid) = struct.unpack_from("<dq", payload, 0)
+                    if rid == GOODBYE_RUN_ID:
+                        self.run_over = True
+                        continue
                     self._apply_server_ping_interval(interval)
                     continue
+                fid = None
+                if topic == T_WEIGHTS and self.trace_negotiated:
+                    (fid, _parent) = _TRACE_CTX.unpack_from(
+                        payload, len(payload) - _TRACE_CTX.size)
+                    payload = payload[:len(payload) - _TRACE_CTX.size]
+                if (self.raw_forward is not None
+                        and topic in (T_DATA, T_DATA_BATCH,
+                                      T_WEIGHTS, T_WEIGHTS_AGG)):
+                    # aggregator relay: pass-through frames forward as
+                    # raw bytes (no decode — a relay needs no jax, and
+                    # the members receive bit-identical payloads).  The
+                    # trace suffix stripped above belongs to the
+                    # server→relay hop; forward_frame opens a fresh
+                    # flow per member on the downstream re-broadcast.
+                    if self.raw_forward(topic, key, bytes(payload)):
+                        if fid is not None:
+                            with self._tracer.span("net.recv",
+                                                   topic="weights",
+                                                   worker=key):
+                                self._tracer.flow_end("weights.wire",
+                                                      fid)
+                        continue
                 if topic == T_DATA_BATCH:
                     (nrows,) = struct.unpack_from("<q", payload, 0)
                     off = 8
@@ -1059,11 +1253,6 @@ class WorkerBridge:
                         rows.append((row.features, row.label))
                     buffers[key].add_many(rows)
                     continue
-                fid = None
-                if topic == T_WEIGHTS and self.trace_negotiated:
-                    (fid, _parent) = _TRACE_CTX.unpack_from(
-                        payload, len(payload) - _TRACE_CTX.size)
-                    payload = payload[:len(payload) - _TRACE_CTX.size]
                 msg = serde.from_bytes(payload)
                 if topic == T_DATA:
                     buffers[key].add(msg.features, msg.label)

@@ -36,8 +36,9 @@ import struct
 import numpy as np
 
 from kafka_ps_tpu.compress import wire as cwire
-from kafka_ps_tpu.runtime.messages import (GradientMessage, KeyRange,
-                                           LabeledData, SparseDeltaMessage,
+from kafka_ps_tpu.runtime.messages import (CompositeDelta, GradientMessage,
+                                           KeyRange, LabeledData,
+                                           SparseDeltaMessage,
                                            WeightsMessage)
 
 MAGIC = b"KPS1"
@@ -53,6 +54,7 @@ _TYPE_IDS = {
     "CompressedWeights": 4,
     "CompressedGradient": 5,
     "SparseDelta": 6,
+    "CompositeDelta": 7,
 }
 _ID_TYPES = {v: k for k, v in _TYPE_IDS.items()}
 
@@ -106,6 +108,15 @@ def from_json(payload: str):
 _HEADER = struct.Struct("<4sBq")          # magic, type id, vector_clock
 _RANGE = struct.Struct("<qqq")            # start, end, worker_id
 _CODEC_HEADER = struct.Struct("<BBfq")    # codec id, flags, param, aux
+# composite delta (tid 7): <B flags><I k members> then k x _MEMBER
+# ((worker, clock) vector-clock map), k x _TRACE (two u64 flow-ctx
+# words, 0/0 = absent), <I d deltas>, then d x (<I len> + a nested
+# to_bytes()-encoded GradientMessage — compressed members reuse the
+# tid-5 body verbatim, so the PS103 no-re-encode contract holds)
+_COMPOSITE_HEAD = struct.Struct("<BI")    # flags (bit0 = summed), k
+_MEMBER = struct.Struct("<qq")            # worker_id, vector_clock
+_TRACE = struct.Struct("<QQ")             # flow ctx (matches net trailer)
+_CHUNK = struct.Struct("<I")              # nested body length
 
 
 def to_bytes(msg) -> bytes:
@@ -137,6 +148,23 @@ def to_bytes(msg) -> bytes:
                              msg.vector_clock) + head
                 + struct.pack("<q", len(idx))
                 + idx.tobytes() + vals.tobytes())
+    if isinstance(msg, CompositeDelta):
+        out = [_HEADER.pack(MAGIC, _TYPE_IDS["CompositeDelta"],
+                            msg.agg_id),
+               _COMPOSITE_HEAD.pack(int(msg.summed), len(msg.members))]
+        for w, c in msg.members:
+            out.append(_MEMBER.pack(w, c))
+        for i in range(len(msg.members)):
+            fid = 0
+            if not msg.summed:
+                fid = int(getattr(msg.deltas[i], "trace", None) or 0)
+            out.append(_TRACE.pack(fid, 0))
+        out.append(_CHUNK.pack(len(msg.deltas)))
+        for d in msg.deltas:
+            body = to_bytes(d)
+            out.append(_CHUNK.pack(len(body)))
+            out.append(body)
+        return b"".join(out)
     if isinstance(msg, LabeledData):
         keys = np.fromiter(msg.features.keys(), dtype="<i4",
                            count=len(msg.features))
@@ -200,6 +228,34 @@ def from_bytes(payload: bytes):
                                   key_range=KeyRange(start, end),
                                   indices=idx, values=vals,
                                   worker_id=worker)
+    if name == "CompositeDelta":
+        flags, k = _COMPOSITE_HEAD.unpack_from(payload, off)
+        off += _COMPOSITE_HEAD.size
+        members = []
+        for _ in range(k):
+            members.append(_MEMBER.unpack_from(payload, off))
+            off += _MEMBER.size
+        fids = []
+        for _ in range(k):
+            fid, _reserved = _TRACE.unpack_from(payload, off)
+            off += _TRACE.size
+            fids.append(fid)
+        (d,) = _CHUNK.unpack_from(payload, off)
+        off += _CHUNK.size
+        deltas = []
+        for _ in range(d):
+            (length,) = _CHUNK.unpack_from(payload, off)
+            off += _CHUNK.size
+            deltas.append(from_bytes(bytes(payload[off:off + length])))
+            off += length
+        summed = bool(flags & 1)
+        if not summed:
+            for m, fid in zip(deltas, fids):
+                if fid:
+                    object.__setattr__(m, "trace", fid)
+        return CompositeDelta(agg_id=clock_or_label,
+                              members=tuple(members),
+                              deltas=tuple(deltas), summed=summed)
     if name == "LabeledData":
         (n,) = struct.unpack_from("<q", payload, off)
         off += 8

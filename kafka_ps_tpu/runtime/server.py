@@ -39,8 +39,9 @@ import numpy as np
 
 from kafka_ps_tpu.parallel.tracker import MessageTracker
 from kafka_ps_tpu.runtime import fabric as fabric_mod
-from kafka_ps_tpu.runtime.messages import (GangNotice, GradientMessage,
-                                           KeyRange, WeightsMessage)
+from kafka_ps_tpu.runtime.messages import (CompositeDelta, GangNotice,
+                                           GradientMessage, KeyRange,
+                                           WeightsMessage)
 from kafka_ps_tpu.telemetry import (CLOCK_BUCKETS, NULL_TELEMETRY,
                                     model_name)
 from kafka_ps_tpu.telemetry.flight import FLIGHT
@@ -206,6 +207,20 @@ class ServerNode:
         # theta stays bitwise-identical either way (the plane only
         # reads values the update already produced).
         self.modelhealth = NULL_MODEL_HEALTH
+        # hierarchical aggregation (kafka_ps_tpu/agg/,
+        # docs/AGGREGATION.md): stacked composites under BSP are
+        # round-buffered here (clock -> {worker: delta}) and applied in
+        # worker-id order once the round is complete, so the aggregated
+        # path is bitwise-identical to a deterministically-ordered
+        # direct run regardless of composite arrival order.
+        # `bsp_order` extends the same ordering to DIRECT gradients
+        # (the determinism knob the tier1 --agg A/B comparison runs
+        # both arms under); `weights_group_send` is the socket bridge's
+        # grouped-fanout hook — one T_WEIGHTS_AGG frame per aggregator
+        # instead of one T_WEIGHTS per member.
+        self._agg_pending: dict[int, dict[int, GradientMessage]] = {}
+        self.bsp_order = False
+        self.weights_group_send = None
 
     # -- tiered residency (kafka_ps_tpu/store/, docs/TIERING.md) -----------
 
@@ -409,6 +424,13 @@ class ServerNode:
         self.tracker.deactivate_worker(worker)
         self.record_membership_event("evict", worker)
         self.tracer.count("server.workers_removed")
+        if self._agg_pending:
+            # drop the evictee's buffered round members and re-check
+            # completeness — an eviction must not strand a BSP round
+            # the dead worker was the last missing member of
+            for bucket in self._agg_pending.values():
+                bucket.pop(worker, None)
+            self._flush_agg_rounds()
         self._flush_gate()
 
     def readmit_worker(self, worker: int) -> int:
@@ -460,13 +482,45 @@ class ServerNode:
         """The consistency dispatch, as an explicit release set: sorted
         per-worker sends (worker-id order keeps serial scheduling
         deterministic) plus the gang notice when several workers were
-        released at the same moment."""
+        released at the same moment.
+
+        When a `weights_group_send` hook is attached (the socket
+        bridge's aggregator fan-out, net.ServerBridge), it gets first
+        claim on the set: members it ships inside grouped frames come
+        back as a handled set and receive bookkeeping only — the same
+        tracker/stamp/metric sequence send_weights runs, minus the
+        per-worker fabric send the grouped frame replaced."""
         release = sorted(release)
+        handled = self._group_send(release, self._weights_message)
         for worker, clock in release:
-            self.send_weights(worker, clock)
+            if worker in handled:
+                self._mark_grouped_release(worker, clock)
+            else:
+                self.send_weights(worker, clock)
         self._emit_gang_notice(release)
         if release:
             self.publish_snapshot()
+
+    def _group_send(self, release, builder) -> set:
+        """Offer a sorted release set to the grouped-fanout hook.
+        `builder(clock)` produces the WeightsMessage a grouped frame
+        carries (one body per distinct clock; the hook re-uses it
+        across members).  Returns the worker ids the hook shipped."""
+        if self.weights_group_send is None or not release:
+            return set()
+        return self.weights_group_send(release, builder)
+
+    def _mark_grouped_release(self, worker: int, clock: int) -> None:
+        """Bookkeeping for a release whose bytes went out inside a
+        grouped aggregator frame: everything send_weights does except
+        the fabric send."""
+        self.weights_sent_at[worker] = time.monotonic()
+        self.tracker.sent_message(worker, clock)
+        self._observe_gate_release(worker)
+        if FLIGHT.enabled:
+            FLIGHT.record("gate.release", shard=self.shard_id,
+                          worker=worker, clock=clock, grouped=True)
+            FLIGHT.beat("gate")
 
     # -- serving plane (kafka_ps_tpu/serving/, docs/SERVING.md) ------------
 
@@ -515,6 +569,20 @@ class ServerNode:
     # -- the hot path (ServerProcessor.java:143-183) -----------------------
 
     def process(self, msg: GradientMessage) -> None:
+        if isinstance(msg, CompositeDelta):
+            self.process_composite(msg)
+            return
+        if (self.bsp_order and self.cfg.max_vector_clock_delay == 0
+                and getattr(msg, "indices", None) is None
+                and msg.key_range.start == self._range.start
+                and msg.key_range.end == self._range.end):
+            # deterministic BSP ordering (docs/AGGREGATION.md): direct
+            # gradients join the same per-round buffer composites use,
+            # so a direct run and an aggregated run apply every round
+            # in identical worker-id order — the A/B determinism knob
+            if self._buffer_round_member(msg):
+                self._flush_agg_rounds()
+            return
         if not self.tracker.tracker[msg.worker_id].active:
             # in-flight gradient from an evicted worker (zombie): drop it
             # rather than corrupt the vector-clock protocol
@@ -756,6 +824,166 @@ class ServerNode:
             self._m_clock_lag.observe(
                 fastest - self.tracker.tracker[worker].vector_clock)
 
+    # -- hierarchical aggregation (kafka_ps_tpu/agg/, docs/AGGREGATION.md) --
+
+    def process_composite(self, comp: CompositeDelta) -> None:
+        """Apply one aggregator composite: the gate advances every
+        member worker's clock from the composite's vector-clock map
+        exactly as if the member deltas had arrived individually.
+
+        Stacked composites expand into their per-member deltas: under
+        BSP they enter the round buffer (worker-id-ordered applies,
+        bitwise-pinned to the ordered direct path); under bounded
+        delay/eventual they apply in member order via `process_batch`
+        (itself bitwise-identical to per-message processing).  Summed
+        composites apply as ONE pre-reduced add per host per clock —
+        exact by linearity, not bitwise-pinned."""
+        self.tracer.count("server.composites_received")
+        if FLIGHT.enabled:
+            FLIGHT.record("agg.composite", shard=self.shard_id,
+                          agg=comp.agg_id, fan_in=comp.fan_in,
+                          summed=comp.summed)
+        if comp.summed:
+            self._process_summed(comp)
+            return
+        resent: set = set()
+        if self.cfg.max_vector_clock_delay == 0:
+            buffered = False
+            for d in comp.deltas:
+                buffered |= self._buffer_round_member(d, resent)
+            if buffered:
+                self._flush_agg_rounds()
+            return
+        live = [d for d in comp.deltas
+                if self._composite_member_live(d.worker_id,
+                                               d.vector_clock, resent)]
+        if live:
+            self.process_batch(live)
+
+    def _composite_member_live(self, worker: int, clock: int,
+                               resent: set | None = None) -> bool:
+        """Zombie/duplicate filter for one composite member, with the
+        aggregator-restart liveness rule: a duplicate whose reply was
+        already issued gets the current weights RE-sent — the original
+        reply may have died inside the SIGKILL'd aggregator, and
+        without a re-send the worker would wait forever (the worker
+        side deduplicates redelivered weights, docs/COMPRESSION.md).
+        `resent` bounds the re-send to once per worker per composite:
+        a reconnecting worker's cache resend can land its whole tail of
+        already-applied clocks inside one composite."""
+        status = self.tracker.tracker[worker]
+        if not status.active:
+            self.tracer.count("server.zombie_gradients_dropped")
+            return False
+        if self.tracker.is_duplicate(worker, clock):
+            self.tracer.count("server.duplicate_gradients_dropped")
+            if status.weights_message_sent and (resent is None
+                                                or worker not in resent):
+                if resent is not None:
+                    resent.add(worker)
+                self.send_weights(worker, status.vector_clock)
+            return False
+        return True
+
+    def _buffer_round_member(self, msg: GradientMessage,
+                             resent: set | None = None) -> bool:
+        """Queue one BSP-round member (from a composite expansion or a
+        `bsp_order` direct gradient) for the ordered flush."""
+        if not self._composite_member_live(msg.worker_id,
+                                           msg.vector_clock, resent):
+            return False
+        bucket = self._agg_pending.setdefault(msg.vector_clock, {})
+        if msg.worker_id in bucket:
+            self.tracer.count("server.duplicate_gradients_dropped")
+            return False
+        bucket[msg.worker_id] = msg
+        return True
+
+    def _flush_agg_rounds(self) -> None:
+        """Apply every complete buffered round, lowest clock first, in
+        worker-id order — ONE process_batch per round, so evals land on
+        the same prefix thetas and releases at the same moments as a
+        worker-id-ordered serial direct run."""
+        while self._agg_pending:
+            clock = min(self._agg_pending)
+            bucket = self._agg_pending[clock]
+            expected = {w for w in self.tracker.active_workers
+                        if self.tracker.tracker[w].vector_clock == clock}
+            if not expected or not expected.issubset(bucket):
+                return
+            del self._agg_pending[clock]
+            self.process_batch([bucket[w] for w in sorted(expected)])
+
+    def _process_summed(self, comp: CompositeDelta) -> None:
+        """One pre-reduced apply for a whole host's round contribution.
+        All members must share one clock (the aggregator only sums a
+        single-clock flush); a partially-duplicate composite is a
+        protocol violation — the sum cannot be partially applied."""
+        clocks = {c for _, c in comp.members}
+        if len(clocks) != 1:
+            raise ValueError(
+                f"summed composite spans clocks {sorted(clocks)}")
+        clock = next(iter(clocks))
+        live, dup = [], []
+        for worker, c in comp.members:
+            if not self.tracker.tracker[worker].active:
+                raise ValueError(
+                    f"summed composite includes evicted worker {worker}")
+            (dup if self.tracker.is_duplicate(worker, c)
+             else live).append(worker)
+        if not live:
+            # whole-composite redelivery (aggregator restart): already
+            # applied — re-issue any already-released replies that may
+            # have died with the aggregator, drop the delta
+            self.tracer.count("server.duplicate_gradients_dropped")
+            for worker in dup:
+                status = self.tracker.tracker[worker]
+                if status.weights_message_sent:
+                    self.send_weights(worker, status.vector_clock)
+            return
+        if dup:
+            raise ValueError(
+                f"summed composite partially applied: duplicates {dup} "
+                f"alongside live members {live}")
+        delta = comp.deltas[0]
+        for worker in live:
+            self.tracker.received_message(worker, clock)
+            self.tracer.count("server.gradients_applied")
+            if self.telemetry.enabled:
+                self._observe_arrival(worker, clock)
+            if FLIGHT.enabled:
+                self._flight_arrival(worker, clock)
+        fid = getattr(delta, "trace", None)
+        self._pending_trace = fid
+        want_eval = (0 in live and self.test_x is not None
+                     and clock % self.cfg.eval_every == 0)
+        m = None
+        with self.tracer.span("server.apply", agg=comp.agg_id,
+                              fan_in=len(live), clock=clock,
+                              shard=self.shard_id, model=self._model):
+            if want_eval:
+                with self.tracer.span("server.eval", clock=clock):
+                    self.theta, m = self._apply_full_eval(
+                        jnp.asarray(self.theta), delta.values,
+                        self.test_x, self.test_y)
+            else:
+                self.theta = self._apply_full(jnp.asarray(self.theta),
+                                              delta.values)
+            self.tracer.count("dispatch.device")
+            self.iterations += len(live)
+        if want_eval:
+            self.last_metrics = m
+            asynclog.submit_or_write(
+                self.log,
+                f"{int(time.time() * 1000)};-1;{clock};"
+                "{};{};{}", m.loss, m.f1, m.accuracy)
+        release: set = set()
+        for worker in live:
+            release |= self.workers_to_respond_to(clock, worker)
+        self.dispatch_release_set(release)
+        self._pending_trace = None
+        self.maybe_checkpoint()
+
     def process_batch(self, msgs: list[GradientMessage]) -> None:
         """Apply several queued gradients as ONE chained jit dispatch
         (gang dispatch, docs/GANG_DISPATCH.md) — bitwise-identical to
@@ -895,8 +1123,25 @@ class ServerNode:
             rel = release_at.get(i)
             if rel:
                 theta_i = prefix_theta.get(i, final_theta)
+                handled = self._group_send(
+                    rel, lambda clock: self._prepared_message(clock,
+                                                              theta_i))
                 for worker, clock in rel:
-                    self._send_weights_prepared(worker, clock, theta_i)
+                    if worker in handled:
+                        # gate bookkeeping (tracker.sent_message) ran at
+                        # decision time above — stamp/metrics only here,
+                        # matching _send_weights_prepared
+                        self.weights_sent_at[worker] = time.monotonic()
+                        self._observe_gate_release(worker)
+                        if FLIGHT.enabled:
+                            FLIGHT.record("gate.release",
+                                          shard=self.shard_id,
+                                          worker=worker, clock=clock,
+                                          gang=True, grouped=True)
+                            FLIGHT.beat("gate")
+                    else:
+                        self._send_weights_prepared(worker, clock,
+                                                    theta_i)
                 batch_released.extend(rel)
                 if self.serving is not None:
                     # gang-path publication point: the prefix theta this
@@ -943,23 +1188,28 @@ class ServerNode:
             self._gang_apply_cache[key] = fn
         return fn
 
-    def _send_weights_prepared(self, worker: int, clock: int,
-                               theta) -> None:
-        """Fabric send for a release whose gate bookkeeping already ran
-        (process_batch records tracker.sent_message at gate-decision
-        time; the send waits for the batched apply to yield the prefix
-        theta this release observes)."""
+    def _prepared_message(self, clock: int, theta) -> WeightsMessage:
+        """WeightsMessage over an already-computed (prefix) theta —
+        the builder the gang release path hands to grouped fan-out.
+        Repeated calls on one theta array reuse the compressor's
+        identity cache, so a multi-member release encodes once."""
         encoded = None
         if self.compressor is not None:
             # prefix thetas of one batch are distinct arrays, but a
             # multi-member release at the SAME position reuses the
             # compressor's identity cache
             theta, encoded = self.compressor.encode(theta)
-        self.fabric.send(
-            fabric_mod.WEIGHTS_TOPIC, worker,
-            WeightsMessage(vector_clock=clock,
-                           key_range=self._range,
-                           values=theta, encoded=encoded))
+        return WeightsMessage(vector_clock=clock, key_range=self._range,
+                              values=theta, encoded=encoded)
+
+    def _send_weights_prepared(self, worker: int, clock: int,
+                               theta) -> None:
+        """Fabric send for a release whose gate bookkeeping already ran
+        (process_batch records tracker.sent_message at gate-decision
+        time; the send waits for the batched apply to yield the prefix
+        theta this release observes)."""
+        self.fabric.send(fabric_mod.WEIGHTS_TOPIC, worker,
+                         self._prepared_message(clock, theta))
         self.weights_sent_at[worker] = time.monotonic()
         self._observe_gate_release(worker)
         if FLIGHT.enabled:

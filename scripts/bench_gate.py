@@ -111,6 +111,20 @@ METRICS = {
         "paths": [("detail", "paths", "tiering_ab", "skew_drive",
                    "hit_rate", "hot"), ("tier_hot_hit_rate",)],
         "direction": "higher", "abs": 0.05, "device_free": True},
+    # aggregation tier (docs/AGGREGATION.md): the gate must keep seeing
+    # host-count messages per clock (cap restates bench.py's assert:
+    # 4 hosts + slack so a partial-flush round cannot flake the gate),
+    # and the summed-mode scaling win past the direct plateau may not
+    # erode
+    "agg_msgs_per_clock": {
+        "paths": [("detail", "paths", "aggregation_ab",
+                   "msgs_per_clock_max"), ("agg_msgs_per_clock",)],
+        "direction": "lower", "cap": 4.5},
+    "agg_updates_per_sec_scaling": {
+        "paths": [("detail", "paths", "aggregation_ab",
+                   "updates_per_sec_scaling"),
+                  ("agg_updates_per_sec_scaling",)],
+        "direction": "higher", "rel": 0.25},
     # absolute caps — the observability planes' cost contracts
     "telemetry_overhead_pct": {
         "paths": [("detail", "paths", "telemetry_overhead",
@@ -157,6 +171,10 @@ METRICS = {
     "tier_bitwise": {
         "paths": [("detail", "paths", "tiering_ab", "all_bitwise"),
                   ("tier_bitwise",)], "must_be_true": True},
+    "agg_n1_bitwise": {
+        "paths": [("detail", "paths", "aggregation_ab",
+                   "all_n1_bitwise"), ("agg_n1_bitwise",)],
+        "must_be_true": True},
 }
 
 _MODELS = ("sequential", "bounded", "eventual")

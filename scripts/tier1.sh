@@ -33,6 +33,14 @@
 # gradients partition through a fresh ServerNode and comparing against
 # the shard's final checkpoint theta bytes (docs/SHARDING.md).
 #
+# `scripts/tier1.sh --agg` runs the aggregation-tier smoke leg
+# (docs/AGGREGATION.md): a socket fleet of 1 server (--bsp-order) + 2
+# aggregator relays x 2 worker processes (4 logical workers), SIGKILL
+# one relay mid-run, restart it (workers resend their caches through
+# it), and assert final theta AND the server eval CSV (timestamps
+# stripped) bitwise-equal to a direct no-relay fleet with the same
+# flags (AGG_SMOKE_OK).
+#
 # `scripts/tier1.sh --load` runs the serving-load smoke leg: a child
 # training process serving over a socket (--serve --serve_port
 # --serve-queue) driven by THIS process's load generator — zero
@@ -426,6 +434,175 @@ for i in range(2):
     replayed.append(n)
 print(f"SHARD_SMOKE_OK shards=2 replayed={replayed} "
       f"iters={MAX_IT} bitwise=recovered")
+EOF
+    exit $?
+fi
+
+if [[ "${1:-}" == "--agg" ]]; then
+    timeout -k 10 540 env JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+# the aggregation-tier A/B (docs/AGGREGATION.md): the SAME training
+# run through two topologies —
+#   direct:      server <-- 2 worker processes (4 logical workers)
+#   aggregated:  server <-- 2 relay processes <-- 2 worker processes
+# with deterministic knobs (--bsp-order on the server so BSP rounds
+# apply in worker-id order; --ready-rows so training starts only after
+# each worker ingested its FULL stream partition), final theta and the
+# server eval CSV must match bitwise.  One relay is SIGKILL'd mid-run
+# and restarted: the workers' redelivery caches resend through it and
+# the server gate deduplicates, so the kill must not show up in either
+# artifact.
+root = tempfile.mkdtemp(prefix="kps-agg-")
+repo = os.getcwd()
+rng = np.random.default_rng(0)
+x = rng.normal(size=(192, 8)).astype(np.float32)
+y = (x[:, 0] > 0).astype(np.int32) + 1
+train, test = os.path.join(root, "train.csv"), os.path.join(root, "test.csv")
+for path, (xx, yy) in ((train, (x[:128], y[:128])),
+                       (test, (x[128:], y[128:]))):
+    with open(path, "w") as fh:
+        fh.write(",".join(f"f{i}" for i in range(8)) + ",Score\n")
+        for r, lab in zip(xx, yy):
+            fh.write(",".join(f"{v:.6f}" for v in r) + f",{lab}\n")
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+MAX_IT = 200
+# 128 rows / 4 workers = 32 per partition = the buffer cap, so
+# --ready-rows 32 means "my whole partition arrived" — ingestion fully
+# precedes training in both arms, which removes stream timing from the
+# comparison
+READY = 32
+common = ["--num_workers", "4", "--num_features", "8",
+          "--num_classes", "2", "--max_iterations", str(MAX_IT)]
+
+def server_proc(tag, port):
+    cwd = os.path.join(root, tag)
+    os.makedirs(cwd, exist_ok=True)
+    p = subprocess.Popen(
+        [sys.executable, "-m", "kafka_ps_tpu.cli.server_runner",
+         "--listen", str(port), "--bsp-order", "-c", "0",
+         "-training", train, "-test", test, "-p", "1", "--logging",
+         "--checkpoint", os.path.join(cwd, "ckpt.npz"), *common],
+        env=env, cwd=cwd, stderr=subprocess.PIPE,
+        stdout=subprocess.DEVNULL, text=True)
+    return p, cwd
+
+def worker_proc(cwd, wids, flag, addr):
+    return subprocess.Popen(
+        [sys.executable, "-m", "kafka_ps_tpu.cli.worker_runner",
+         flag, addr, "--worker_ids", wids, "-test", test,
+         "-min", "8", "-max", "32", "--ready-rows", str(READY),
+         *common],
+        env=env, cwd=cwd, stderr=subprocess.PIPE,
+        stdout=subprocess.DEVNULL, text=True)
+
+def agg_proc(cwd, agg_id, wids, sport, aport):
+    return subprocess.Popen(
+        [sys.executable, "-m", "kafka_ps_tpu.cli.agg_runner",
+         "--connect", f"127.0.0.1:{sport}", "--listen", str(aport),
+         "--agg-id", str(agg_id), "--worker_ids", wids, *common],
+        env=env, cwd=cwd, stderr=subprocess.PIPE,
+        stdout=subprocess.DEVNULL, text=True)
+
+def finish(procs, deadline_s=240):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if all(p.poll() is not None for p in procs.values()):
+            break
+        time.sleep(0.25)
+    else:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for name, p in procs.items():
+            print(f"== {name} rc={p.poll()}\n{p.stderr.read()[-4000:]}",
+                  file=sys.stderr)
+        raise SystemExit("fleet did not finish in time")
+    bad = []
+    for name, p in procs.items():
+        err = p.stderr.read()
+        if p.returncode != 0:
+            print(f"== {name} rc={p.returncode}\n{err[-4000:]}",
+                  file=sys.stderr)
+            bad.append(name)
+    assert not bad, f"{bad} failed"
+
+def csv_rows(cwd):
+    # column 0 is the wall-clock timestamp — the only legal difference
+    with open(os.path.join(cwd, "logs-server.csv")) as fh:
+        return [";".join(ln.split(";")[1:]) for ln in fh.read().splitlines()]
+
+# -- arm 1: direct (no relays) --------------------------------------------
+pd = free_port()
+sd, dcwd = server_proc("direct", pd)
+finish({"server": sd,
+        "worker01": worker_proc(dcwd, "0,1", "--connect",
+                                f"127.0.0.1:{pd}"),
+        "worker23": worker_proc(dcwd, "2,3", "--connect",
+                                f"127.0.0.1:{pd}")})
+
+# -- arm 2: aggregated, with a relay SIGKILL + restart mid-run ------------
+pa, a0, a1 = free_port(), free_port(), free_port()
+sa, acwd = server_proc("agg", pa)
+r0 = agg_proc(acwd, 0, "0,1", pa, a0)
+r1 = agg_proc(acwd, 1, "2,3", pa, a1)
+w01 = worker_proc(acwd, "0,1", "--aggregate", f"127.0.0.1:{a0}")
+w23 = worker_proc(acwd, "2,3", "--aggregate", f"127.0.0.1:{a1}")
+
+# kill relay 0 once the server's eval CSV shows real training progress
+csv_path = os.path.join(acwd, "logs-server.csv")
+deadline = time.monotonic() + 120
+while time.monotonic() < deadline:
+    try:
+        with open(csv_path) as fh:
+            n = sum(1 for _ in fh) - 1
+    except OSError:
+        n = 0
+    if n >= 16:
+        break
+    for name, p in (("server", sa), ("relay0", r0)):
+        if p.poll() is not None:
+            print(p.stderr.read(), file=sys.stderr)
+            raise SystemExit(f"{name} exited before the kill point")
+    time.sleep(0.05)
+else:
+    raise SystemExit("aggregated server never made progress")
+os.kill(r0.pid, signal.SIGKILL)
+r0.wait()
+time.sleep(0.5)
+# same listen port: the members' supervisor reconnects there and
+# resends the whole redelivery cache (the relay itself held no state)
+r0b = agg_proc(acwd, 0, "0,1", pa, a0)
+finish({"server": sa, "relay0-restarted": r0b, "relay1": r1,
+        "worker01": w01, "worker23": w23})
+
+# -- the bitwise pin -------------------------------------------------------
+zd = np.load(os.path.join(dcwd, "ckpt.npz"))
+za = np.load(os.path.join(acwd, "ckpt.npz"))
+assert int(zd["iterations"]) >= MAX_IT <= int(za["iterations"])
+assert za["theta"].tobytes() == zd["theta"].tobytes(), \
+    "aggregated theta diverged from the direct run"
+assert csv_rows(acwd) == csv_rows(dcwd) != [], \
+    "aggregated eval CSV diverged from the direct run"
+print(f"AGG_SMOKE_OK relays=2 workers=4 iters={MAX_IT} "
+      f"kill=relay0+restart theta=bitwise csv=bitwise")
 EOF
     exit $?
 fi

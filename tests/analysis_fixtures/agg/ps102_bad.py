@@ -1,0 +1,9 @@
+"""PS102 positive fixture (scoped: lives under an agg/ path): a host
+sync inside the aggregator's combine path — charged once per member
+per clock, defeating the fan-in reduction."""
+import numpy as np
+
+
+class Aggregator:
+    def combine(self):
+        return np.asarray(self._pending)

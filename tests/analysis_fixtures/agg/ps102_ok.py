@@ -1,0 +1,10 @@
+"""PS102 negative fixture: the combine path stays host-scalar-only —
+deltas pass through as already-materialized message fields."""
+
+
+class Aggregator:
+    def combine(self):
+        deltas = sorted(self._pending.values(),
+                        key=lambda d: (d.worker_id, d.vector_clock))
+        self._pending.clear()
+        return tuple(deltas)

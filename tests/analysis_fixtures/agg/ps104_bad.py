@@ -1,0 +1,8 @@
+"""PS104 positive fixture: a wall-clock read in the aggregation tier —
+combine order and checkpoint state must be pure functions of
+(worker, clock) for the N=1 bitwise pin to hold."""
+import time
+
+
+def checkpoint_name(agg_id):
+    return f"agg-{agg_id}-{time.time()}.npz"

@@ -1,0 +1,11 @@
+"""PS104 negative fixture: checkpoint identity derives from the run id
+and flush ordinal — replay-stable; time.monotonic pacing is allowed."""
+import time
+
+
+def checkpoint_name(agg_id, run_id, flush_ordinal):
+    return f"agg-{agg_id}-{run_id}-{flush_ordinal}.npz"
+
+
+def pace(deadline):
+    return time.monotonic() < deadline

@@ -1,0 +1,8 @@
+"""PS105 positive fixture: the relay forwards a frame while still
+holding its stash lock — every member behind it stalls."""
+
+
+class Relay:
+    def forward(self, sock, frame):
+        with self._stash_lock:
+            sock.sendall(frame)

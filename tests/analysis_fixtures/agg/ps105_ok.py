@@ -1,0 +1,11 @@
+"""PS105 negative fixture: stash bookkeeping under the lock, the send
+outside it — the relay's actual forwarding discipline."""
+
+
+class Relay:
+    def forward(self, sock, worker, frame):
+        with self._stash_lock:
+            stale = self._stash.pop(worker, None)
+        if stale is not None:
+            sock.sendall(stale)
+        sock.sendall(frame)

@@ -1,0 +1,7 @@
+"""PS106 positive fixture: the fan-in metric fetches a device value
+inside the telemetry call's arguments — the observation syncs the very
+path it measures."""
+
+
+def note_flush(counter, composite):
+    counter.inc(float(composite.wire_cost))

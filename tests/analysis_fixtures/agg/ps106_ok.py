@@ -1,0 +1,7 @@
+"""PS106 negative fixture: metrics observe host integers the flush
+path already owns (fan-in counts, byte lengths)."""
+
+
+def note_flush(counter, fan_in_metric, payload, members):
+    counter.inc(len(payload))
+    fan_in_metric.observe(len(members))

@@ -1,0 +1,516 @@
+"""Hierarchical aggregation tier (kafka_ps_tpu/agg/, docs/AGGREGATION
+.md): vector-clock merge algebra, composite wire framing, the
+aggregator's combine/EF semantics, the server gate's composite
+processing — including the N=1 bitwise pin against the direct path for
+all three consistency models — and the relay's socket plumbing."""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kafka_ps_tpu.agg import LocalAggregator, merge_composites, \
+    split_composite
+from kafka_ps_tpu.agg.core import direct_equivalent
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.runtime import net, serde
+from kafka_ps_tpu.runtime.messages import (CompositeDelta, GradientMessage,
+                                           KeyRange, WeightsMessage)
+from kafka_ps_tpu.utils.config import EVENTUAL
+
+N = 6
+
+
+def gm(w, c, n=N, values=None):
+    if values is None:
+        rng = np.random.default_rng(w * 1009 + c)
+        values = rng.standard_normal(n).astype(np.float32)
+    return GradientMessage(vector_clock=c, key_range=KeyRange(0, n),
+                           values=values, worker_id=w)
+
+
+def comp_of(*msgs, agg_id=0, summed=False):
+    msgs = sorted(msgs, key=lambda m: (m.worker_id, m.vector_clock))
+    return CompositeDelta(
+        agg_id=agg_id,
+        members=tuple((m.worker_id, m.vector_clock) for m in msgs),
+        deltas=tuple(msgs), summed=summed)
+
+
+# -- merge algebra (the semilattice join) ------------------------------------
+
+def test_merge_is_commutative():
+    a = comp_of(gm(0, 0), gm(1, 0))
+    b = comp_of(gm(2, 0), gm(3, 1))
+    ab, ba = merge_composites(a, b), merge_composites(b, a)
+    assert serde.to_bytes(ab) == serde.to_bytes(ba)
+    assert ab.members == ((0, 0), (1, 0), (2, 0), (3, 1))
+
+
+def test_merge_is_associative():
+    a, b, c = comp_of(gm(0, 0)), comp_of(gm(1, 0)), comp_of(gm(0, 1))
+    left = merge_composites(merge_composites(a, b), c)
+    right = merge_composites(a, merge_composites(b, c))
+    assert serde.to_bytes(left) == serde.to_bytes(right)
+
+
+def test_merge_dedups_redelivered_members():
+    """A redelivered (worker, clock) carries identical bytes (resends
+    come from the redelivery cache, never recomputation), so overlap
+    collapses to one entry regardless of merge order."""
+    d = gm(1, 3)
+    a = comp_of(gm(0, 3), d)
+    b = comp_of(dataclasses.replace(d), gm(2, 3))   # partial overlap
+    merged = merge_composites(a, b)
+    assert merged.members == ((0, 3), (1, 3), (2, 3))
+    assert merged.fan_in == 3
+    i = merged.members.index((1, 3))
+    np.testing.assert_array_equal(merged.deltas[i].values, d.values)
+
+
+def test_merge_is_idempotent():
+    a = comp_of(gm(0, 0), gm(1, 0))
+    assert serde.to_bytes(merge_composites(a, a)) == serde.to_bytes(a)
+
+
+def test_merge_rejects_summed():
+    s = comp_of(gm(0, 0), summed=True)
+    with pytest.raises(ValueError, match="stacked"):
+        merge_composites(s, comp_of(gm(1, 0)))
+
+
+def test_direct_equivalent_rejects_summed():
+    with pytest.raises(ValueError, match="summed"):
+        direct_equivalent(comp_of(gm(0, 0), summed=True))
+
+
+# -- shard-split composition -------------------------------------------------
+
+def test_split_composite_slices_every_member():
+    from kafka_ps_tpu.runtime.sharding import ShardPlan
+    plan = ShardPlan(N, 2)
+    c = comp_of(gm(0, 0), gm(1, 0))
+    parts = split_composite(plan, c)
+    assert len(parts) == 2
+    for part, r in zip(parts, plan.ranges):
+        assert part.members == c.members
+        for d in part.deltas:
+            assert d.key_range == KeyRange(r.start, r.end)
+    for i in range(2):      # slices reassemble to the original values
+        whole = np.concatenate([p.deltas[i].values for p in parts])
+        np.testing.assert_array_equal(whole, c.deltas[i].values)
+
+
+# -- composite wire format (serde tid 7) -------------------------------------
+
+def test_composite_roundtrip_preserves_trace_fids():
+    a, b = gm(0, 4), gm(1, 4)
+    object.__setattr__(a, "trace", 0xDEADBEEF)
+    c = comp_of(a, b)
+    back = serde.from_bytes(serde.to_bytes(c))
+    assert back.members == c.members and not back.summed
+    fids = [getattr(d, "trace", None) for d in back.deltas]
+    assert fids == [0xDEADBEEF, None]
+    assert serde.to_bytes(back) == serde.to_bytes(c)
+
+
+def test_composite_roundtrip_compressed_members():
+    """Compressed members ride as nested tid-5 bodies verbatim — the
+    no-re-encode contract (PS103) extends through the composite."""
+    from kafka_ps_tpu import compress
+    codec = compress.get_codec(compress.parse_codec("int8"), N)
+    ef = compress.ErrorFeedback(codec)
+    raw = gm(0, 2)
+    decoded, enc = ef.step(raw.values)
+    msg = dataclasses.replace(raw, values=decoded, encoded=enc)
+    c = comp_of(msg, gm(1, 2))
+    blob = serde.to_bytes(c)
+    back = serde.from_bytes(blob)
+    assert back.deltas[0].encoded is not None
+    assert serde.to_bytes(back) == blob
+
+
+def test_composite_summed_roundtrip():
+    s = CompositeDelta(agg_id=3, members=((0, 5), (1, 5)),
+                       deltas=(gm(0, 5),), summed=True)
+    back = serde.from_bytes(serde.to_bytes(s))
+    assert back.summed and back.agg_id == 3 and back.fan_in == 2
+
+
+# -- LocalAggregator combine semantics ---------------------------------------
+
+def test_offer_dedups_pending_duplicates():
+    agg = LocalAggregator(0, N)
+    d = gm(0, 0)
+    assert agg.offer(d) and not agg.offer(dataclasses.replace(d))
+    assert agg.pending_count == 1
+
+
+def test_combine_drains_sorted_and_idles():
+    agg = LocalAggregator(0, N)
+    for d in (gm(2, 0), gm(0, 1), gm(0, 0)):
+        agg.offer(d)
+    c = agg.combine()
+    assert c.members == ((0, 0), (0, 1), (2, 0))
+    assert agg.pending_count == 0 and agg.combine() is None
+
+
+def test_summed_requires_single_clock_else_stacked():
+    agg = LocalAggregator(0, N, summed=True)
+    a, b = gm(0, 0), gm(1, 0)
+    agg.offer(a), agg.offer(b)
+    c = agg.combine()
+    assert c.summed and len(c.deltas) == 1
+    np.testing.assert_allclose(c.deltas[0].values, a.values + b.values,
+                               rtol=0, atol=0)
+    # mixed clocks degrade THAT flush to stacked
+    agg.offer(gm(0, 1)), agg.offer(gm(1, 2))
+    c2 = agg.combine()
+    assert not c2.summed and len(c2.deltas) == 2
+
+
+def _int8_spec():
+    from kafka_ps_tpu.compress.wire import parse_codec
+    return parse_codec("int8")
+
+
+def test_ef_horizon_makes_resends_bitwise_safe():
+    """A resend AT the horizon returns the cached encode verbatim; one
+    BELOW it drops; neither advances the residual — so the stream of
+    encodes matches an uninterrupted error-feedback sequence."""
+    from kafka_ps_tpu import compress
+    agg = LocalAggregator(0, N, codec_spec=_int8_spec())
+    ref = compress.ErrorFeedback(
+        compress.get_codec(_int8_spec(), N))     # the uninterrupted EF
+    d0, d1 = gm(0, 0), gm(0, 1)
+    agg.offer(d0)
+    first = agg.combine().deltas[0]
+    agg.offer(dataclasses.replace(d0))           # resend at the horizon
+    again = agg.combine().deltas[0]
+    assert serde.to_bytes(again) == serde.to_bytes(first)
+    agg.offer(d1)                                # fresh clock: advances
+    second = agg.combine().deltas[0]
+    agg.offer(dataclasses.replace(d0))           # now BELOW the horizon
+    assert agg.combine() is None                 # dropped entirely
+    ref0, _ = ref.step(d0.values)
+    ref1, _ = ref.step(d1.values)
+    np.testing.assert_array_equal(first.values, ref0)
+    np.testing.assert_array_equal(second.values, ref1)
+
+
+def test_ef_state_restore_is_bitwise():
+    """The relay checkpoint seam: snapshot → reset (the SIGKILL) →
+    restore → the next encode is byte-identical to never crashing,
+    and a resend of the horizon clock still returns cached bytes."""
+    agg = LocalAggregator(0, N, codec_spec=_int8_spec())
+    twin = LocalAggregator(0, N, codec_spec=_int8_spec())
+    d0, d1 = gm(0, 0), gm(0, 1)
+    for a in (agg, twin):
+        a.offer(dataclasses.replace(d0))
+        a.combine()
+    state = agg.ef_state()
+    agg.reset()
+    assert agg.combine() is None                 # EF plane really gone
+    agg.ef_restore(state)
+    agg.offer(dataclasses.replace(d0))           # the worker's resend
+    twin.offer(dataclasses.replace(d0))
+    assert serde.to_bytes(agg.combine()) == serde.to_bytes(twin.combine())
+    agg.offer(dataclasses.replace(d1))
+    twin.offer(dataclasses.replace(d1))
+    assert serde.to_bytes(agg.combine()) == serde.to_bytes(twin.combine())
+
+
+# -- the server gate on composites: N=1 bitwise pin --------------------------
+
+def _small_cfg(consistency, compress="none"):
+    from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
+                                           PSConfig, StreamConfig)
+    return PSConfig(
+        num_workers=4, consistency_model=consistency,
+        model=ModelConfig(num_features=8, num_classes=2,
+                          local_learning_rate=0.5),
+        buffer=BufferConfig(min_size=8, max_size=32),
+        stream=StreamConfig(time_per_event_ms=1.0),
+        use_gang=False, compress=compress,
+    )
+
+
+def _make_app(consistency, compress="none"):
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+    from tests.test_runtime import fill_buffers, make_dataset
+    x, y = make_dataset()
+    app = StreamingPSApp(_small_cfg(consistency, compress), test_x=x,
+                         test_y=y, server_log=[].append,
+                         worker_log=[].append)
+    fill_buffers(app, x, y)
+    return app
+
+
+def _deliver_weights(app, delivered):
+    """Pump weights worker-id order with the WeightsAssembler's dedup
+    (clock <= last delivered drops) — the worker-side semantics of the
+    real --aggregate deployment (cli/socket_mode._run_worker_sharded),
+    where duplicate-liveness re-sends never reach the WorkerNode."""
+    for worker in app.workers:
+        w = worker.worker_id
+        while True:
+            msg = app.fabric.poll(fabric_mod.WEIGHTS_TOPIC, w)
+            if msg is None:
+                break
+            if msg.vector_clock <= delivered.get(w, -1):
+                continue
+            delivered[w] = msg.vector_clock
+            worker.on_weights(msg)
+
+
+def _run_direct(consistency, iters, compress="none"):
+    app = _make_app(consistency, compress)
+    app.server.start_training_loop()
+    delivered = {}
+    stalled = 0
+    while app.server.iterations < iters:
+        _deliver_weights(app, delivered)
+        progressed = False
+        while app.server.iterations < iters:
+            g = app.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0)
+            if g is None:
+                break
+            app.server.process(g)
+            progressed = True
+        stalled = 0 if progressed else stalled + 1
+        assert stalled < 100, "direct pump deadlocked"
+    return app
+
+
+def _run_aggregated(consistency, iters, compress="none",
+                    restart_at=None):
+    """One aggregator in front of ALL workers (the N=1 pin): workers
+    ship raw deltas, the aggregator owns EF when compressing, every
+    flush is one composite into the gate."""
+    app = _make_app(consistency, "none")
+    spec = _int8_spec() if compress != "none" else None
+    if spec is not None:
+        from kafka_ps_tpu import compress as comp_mod
+        codec = comp_mod.get_codec(spec, app.server.task.num_params)
+        app.server.compressor = comp_mod.WeightsCompressor(codec)
+    agg = LocalAggregator(0, app.server.task.num_params, codec_spec=spec)
+    app.server.start_training_loop()
+    delivered = {}
+    last_sent = {}          # worker -> last delta (the redelivery cache)
+    stalled = 0
+    rounds = 0
+    while app.server.iterations < iters:
+        _deliver_weights(app, delivered)
+        while True:
+            g = app.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0)
+            if g is None:
+                break
+            last_sent[g.worker_id] = g
+            agg.offer(g)
+        progressed = agg.pending_count > 0
+        c = agg.combine()
+        if c is not None:
+            app.server.process(c)
+        rounds += 1
+        if restart_at is not None and rounds == restart_at:
+            # SIGKILL simulation at a quiescent point: pending and EF
+            # state die; the checkpoint restores EF; the workers
+            # resend their caches, which the horizon/dedup absorb
+            state = agg.ef_state()
+            agg.reset()
+            agg.ef_restore(state)
+            for g in last_sent.values():
+                agg.offer(dataclasses.replace(g))
+            dup = agg.combine()
+            if dup is not None:
+                app.server.process(dup)
+        stalled = 0 if progressed else stalled + 1
+        assert stalled < 100, "aggregated pump deadlocked"
+    return app
+
+
+def _theta_bytes(app):
+    return np.asarray(app.server.theta, dtype=np.float32).tobytes()
+
+
+@pytest.mark.parametrize("consistency", [0, 3, EVENTUAL])
+def test_n1_aggregator_bitwise_matches_direct(consistency):
+    direct = _run_direct(consistency, 24)
+    agg = _run_aggregated(consistency, 24)
+    assert _theta_bytes(direct) == _theta_bytes(agg)
+    assert direct.server.iterations == agg.server.iterations
+    dm, am = direct.server.last_metrics, agg.server.last_metrics
+    assert dm is not None and am is not None
+    assert float(dm.loss) == float(am.loss)
+
+
+def test_n1_aggregator_bitwise_under_int8():
+    direct = _run_direct(0, 24, compress="int8")
+    agg = _run_aggregated(0, 24, compress="int8")
+    assert _theta_bytes(direct) == _theta_bytes(agg)
+
+
+def test_n1_aggregator_bitwise_under_int8_with_restart():
+    baseline = _run_aggregated(0, 24, compress="int8")
+    restarted = _run_aggregated(0, 24, compress="int8", restart_at=3)
+    assert _theta_bytes(baseline) == _theta_bytes(restarted)
+
+
+def test_summed_composite_exact_for_bsp():
+    """Summed mode is exact by linearity (one apply per host per
+    clock), not bitwise: the learned model must land within float
+    tolerance of the direct path and apply fewer server iterations."""
+    direct = _run_direct(0, 24)
+    app = _make_app(0, "none")
+    agg = LocalAggregator(0, app.server.task.num_params, summed=True)
+    app.server.start_training_loop()
+    delivered = {}
+    while app.server.iterations < 24:
+        _deliver_weights(app, delivered)
+        while True:
+            g = app.fabric.poll(fabric_mod.GRADIENTS_TOPIC, 0)
+            if g is None:
+                break
+            agg.offer(g)
+        c = agg.combine()
+        if c is not None:
+            app.server.process(c)
+    np.testing.assert_allclose(
+        np.asarray(app.server.theta, np.float32),
+        np.asarray(direct.server.theta, np.float32),
+        rtol=2e-5, atol=2e-6)
+
+
+def test_composite_duplicate_liveness_resends_weights_once():
+    """A composite full of already-applied clocks (aggregator-restart
+    replay) re-issues each member's weights AT MOST ONCE per composite
+    — the reply may have died with the relay, but a 64-clock cache
+    resend must not trigger 64 re-sends."""
+    app = _run_direct(3, 12)
+    server = app.server
+    w = 0
+    clock = server.tracker.tracker[w].vector_clock
+    assert server.tracker.tracker[w].weights_message_sent
+    stale = [gm(w, clock - 2, n=server.task.num_params),
+             gm(w, clock - 1, n=server.task.num_params)]
+    before = app.fabric.pending(fabric_mod.WEIGHTS_TOPIC, w)
+    iters = server.iterations
+    server.process(comp_of(*stale))
+    assert app.fabric.pending(fabric_mod.WEIGHTS_TOPIC, w) == before + 1
+    assert server.iterations == iters        # nothing applied
+
+
+# -- relay plumbing over real sockets ----------------------------------------
+
+class _Rows:
+    def __init__(self):
+        self.rows = []
+        self.count = 0
+
+    def add(self, features, label):
+        self.rows.append((features, label))
+        self.count += 1
+
+    def add_many(self, rows):
+        for f, l in rows:
+            self.add(f, l)
+
+
+def _wait(cond, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {what}"
+        time.sleep(0.01)
+
+
+def test_relay_end_to_end_over_sockets():
+    """Server ← relay ← two workers: early data rows stash until the
+    member connects, member gradients reach the server as composites,
+    and one grouped weights frame fans out re-stamped per member."""
+    from kafka_ps_tpu.agg.relay import AggregatorRelay
+    server = net.ServerBridge(run_id=42)
+    sfab = server.wrap(fabric_mod.Fabric())
+    relay = None
+    bridges = []
+    threads = []
+    try:
+        relay = AggregatorRelay(7, "127.0.0.1", server.port, [0, 1], N)
+        loop = threading.Thread(target=relay.run, daemon=True)
+        loop.start()
+        threads.append(loop)
+        server.wait_for_connected([0, 1], timeout=10.0)  # via the relay
+        # a row produced before worker 0 exists — must not be lost
+        assert server.send_data(0, {1: 2.0}, 1)
+        buffers = {0: _Rows(), 1: _Rows()}
+        for w in (0, 1):
+            b = net.WorkerBridge("127.0.0.1", relay.port, [w])
+            assert b.server_run_id == 42     # upstream run id advertised
+            b.make_fabric()
+            t = threading.Thread(target=b.run_reader,
+                                 args=({w: buffers[w]},), daemon=True)
+            t.start()
+            bridges.append(b)
+            threads.append(t)
+        _wait(lambda: buffers[0].count == 1, what="stashed row delivery")
+        for w, b in enumerate(bridges):
+            b.mark_ready(w)
+        server.wait_for_workers([0, 1], timeout=10.0)
+        for w, b in enumerate(bridges):
+            b.send_gradients(0, gm(w, 0))
+        got = None
+        deadline = time.monotonic() + 10.0
+        while got is None or got.fan_in < 2:
+            c = sfab.poll_blocking(fabric_mod.GRADIENTS_TOPIC, 0,
+                                   timeout=0.2)
+            if c is not None:
+                assert isinstance(c, CompositeDelta) and c.agg_id == 7
+                got = c if got is None else merge_composites(got, c)
+            assert time.monotonic() < deadline, "no composite arrived"
+        assert got.members == ((0, 0), (1, 0))
+        theta = np.arange(N, dtype=np.float32)
+        handled = server.send_weights_group(
+            [(0, 5), (1, 9)],
+            lambda clock: WeightsMessage(vector_clock=clock,
+                                         key_range=KeyRange(0, N),
+                                         values=theta))
+        assert handled == {0, 1}
+        for w, want_clock in ((0, 5), (1, 9)):
+            msg = bridges[w].fabric.poll_blocking(
+                fabric_mod.WEIGHTS_TOPIC, w, timeout=10.0)
+            assert msg is not None and msg.vector_clock == want_clock
+            np.testing.assert_array_equal(msg.values, theta)
+    finally:
+        for b in bridges:
+            b.close()
+        if relay is not None:
+            relay.close()
+        server.close()
+        for t in threads:
+            t.join(timeout=10.0)
+
+
+def test_goodbye_marks_clean_close_but_crash_does_not():
+    """A cleanly-closing relay sends the GOODBYE config so members stop;
+    a connection dropped without it leaves `run_over` False — the signal
+    the aggregated worker supervisor uses to hold the run open and
+    reconnect after a relay SIGKILL (cli/socket_mode)."""
+    for clean in (True, False):
+        server = net.ServerBridge(run_id=9)
+        b = net.WorkerBridge("127.0.0.1", server.port, [0])
+        t = threading.Thread(target=b.run_reader, args=({0: _Rows()},),
+                             daemon=True)
+        t.start()
+        try:
+            server.wait_for_connected([0], timeout=10.0)
+            if clean:
+                server.send_goodbye()
+                _wait(lambda: b.run_over, what="goodbye delivery")
+            server.close()
+            _wait(b.disconnected.is_set, what="EOF after close")
+            assert b.run_over is clean
+        finally:
+            b.close()
+            server.close()
+            t.join(timeout=10.0)

@@ -45,6 +45,10 @@ def _findings(relpath: str):
     ("telemetry/critpath.py", "PS104"),
     ("telemetry/slo.py", "PS106"),
     ("telemetry/drift.py", "PS104"),
+    ("agg/ps102_bad.py", "PS102"),
+    ("agg/ps104_bad.py", "PS104"),
+    ("agg/ps105_bad.py", "PS105"),
+    ("agg/ps106_bad.py", "PS106"),
 ])
 def test_positive_fixture_triggers_exactly_once(relpath, rule):
     found = _findings(relpath)
@@ -72,6 +76,10 @@ def test_positive_fixture_triggers_exactly_once(relpath, rule):
     "runtime/ps106_flight_ok.py",
     "telemetry/profiler.py",
     "telemetry/modelhealth.py",
+    "agg/ps102_ok.py",
+    "agg/ps104_ok.py",
+    "agg/ps105_ok.py",
+    "agg/ps106_ok.py",
 ])
 def test_negative_fixture_stays_clean(relpath):
     assert _findings(relpath) == []
