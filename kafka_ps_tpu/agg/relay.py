@@ -73,7 +73,7 @@ class AggregatorRelay:
                  heartbeat_interval: float | None = None,
                  heartbeat_timeout: float | None = None,
                  connect_timeout: float = 30.0,
-                 tracer=None, telemetry=None):
+                 tracer=None, telemetry=None, coalesce: bool = True):
         self.agg_id = agg_id
         self.worker_ids = list(worker_ids)
         self.flush_interval = flush_interval
@@ -88,7 +88,7 @@ class AggregatorRelay:
             connect_timeout=connect_timeout,
             heartbeat_timeout=heartbeat_timeout,
             codec=codec_spec, tracer=tracer, telemetry=telemetry,
-            aggregator=True)
+            aggregator=True, coalesce=coalesce)
         spec = (self.upstream.negotiated
                 if self.upstream.negotiated.codec_id != CODEC_NONE
                 else None)
@@ -107,7 +107,7 @@ class AggregatorRelay:
             run_id=self.upstream.server_run_id or 0,
             heartbeat_interval=heartbeat_interval,
             heartbeat_timeout=heartbeat_timeout,
-            tracer=tracer, telemetry=telemetry)
+            tracer=tracer, telemetry=telemetry, coalesce=coalesce)
         self.port = self.downstream.port
         self.fabric = self.downstream.wrap(fabric_mod.Fabric())
         # rows/weights that arrived before their worker connected: the

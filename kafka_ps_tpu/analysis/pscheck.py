@@ -19,7 +19,8 @@ time; these rules catch the regressions at commit time instead:
          ``encoded`` parts; int8 quantization is not idempotent.
   PS104  nondeterminism in replay-critical modules (``log/``,
          ``compress/``, ``store/``, ``agg/``, ``runtime/serde.py``,
-         ``runtime/sharding.py``, ``parallel/range_sharded.py``): wall
+         ``runtime/sharding.py``, ``runtime/wire.py``,
+         ``parallel/range_sharded.py``): wall
          clocks, ``random``, ``np.random``, ``uuid``/``urandom``, and
          iteration over a bare ``set(...)`` (hash order) — replay must
          be bitwise.  The sharding modules are replay-critical because
@@ -37,8 +38,12 @@ time; these rules catch the regressions at commit time instead:
          is what makes them a usable rollback trigger (ROADMAP item
          1).  The profiler's display-only wall anchor is the one
          reasoned suppression.
-  PS105  blocking I/O (socket send/recv, frame send/recv, ``fsync``,
-         ``time.sleep``) while holding a lock.
+  PS105  blocking I/O (socket send/recv/``sendmsg``, frame send/recv,
+         the wire engine's ``sendmsg_all``, ``fsync``, ``time.sleep``)
+         while holding a lock.  ``runtime/wire.py``'s FrameWriter is
+         the rule made structural: producers hold the queue lock only
+         for the append, and the writer thread pops a batch under the
+         lock but ships it outside (``_pop_batch`` / ``_drain``).
   PS106  host-sync calls (``.item()``, ``float()``, ``np.asarray``,
          ``np.array``, ``.block_until_ready()``) inside the ARGUMENTS
          of a telemetry/trace call (``span``, ``count``, ``observe``,
@@ -120,6 +125,10 @@ HANDLER_NAMES = frozenset({
     "combine", "_encode", "flush",
     "_on_upstream_frame", "_forward_rows", "_forward_weights",
     "_expand_group",
+    # runtime/wire.py: the coalescing writer's pop/flush loop and the
+    # buffered reader's parse loop — once per flush batch / per frame;
+    # a host sync here stalls every connection sharing the writer
+    "_drain", "_pop_batch", "recv_frame", "_fill",
 })
 
 # PS102 host-sync markers
@@ -147,10 +156,11 @@ _OS_BANNED = frozenset({"urandom"})
 # PS105 blocking markers
 _BLOCKING_ATTRS = frozenset({
     "sendall", "recv", "recv_into", "accept", "connect", "sendto",
-    "recvfrom", "fsync", "sleep",
+    "recvfrom", "sendmsg", "fsync", "sleep",
 })
 _BLOCKING_NAMES = frozenset({
     "send_frame", "recv_frame", "create_connection", "fsync",
+    "sendmsg_all",
 })
 _LOCKISH = re.compile(r"lock|mutex|cond|cv|(?:^|[._])mu$", re.IGNORECASE)
 
@@ -572,6 +582,7 @@ def _rules_for(path: Path) -> set:
             or "agg" in parts
             or (path.name == "serde.py" and "runtime" in parts)
             or (path.name == "sharding.py" and "runtime" in parts)
+            or (path.name == "wire.py" and "runtime" in parts)
             or (path.name == "range_sharded.py" and "parallel" in parts)):
         # agg/ is replay-critical end to end: combine order, the EF
         # clock horizon and checkpoint restore must be pure functions

@@ -316,6 +316,19 @@ def build_parser(include_server_flags: bool = True,
                    help="offer co-located PredictClients a shared-memory "
                         "fast path (skips TCP framing); remote or legacy "
                         "clients fall back to sockets transparently")
+    p.add_argument("--wire-coalesce", dest="wire_coalesce",
+                   action="store_true", default=True,
+                   help="frame coalescing on socket bridges (default ON): "
+                        "sends queue behind a per-connection writer "
+                        "thread that ships every queued frame in one "
+                        "scatter-gather sendmsg; receives parse all "
+                        "complete frames per recv_into chunk "
+                        "(docs/WIRE.md)")
+    p.add_argument("--no-wire-coalesce", dest="wire_coalesce",
+                   action="store_false",
+                   help="disable frame coalescing: one sendall per frame "
+                        "under the connection lock (the pre-wire-engine "
+                        "behaviour; byte stream is identical either way)")
     return p
 
 

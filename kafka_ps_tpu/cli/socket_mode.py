@@ -311,7 +311,8 @@ def run_server(args) -> int:
         run_id=run_id,
         codec=codec_spec,
         tracer=tracer, telemetry=telemetry,
-        shm=getattr(args, "serve_shm", False))
+        shm=getattr(args, "serve_shm", False),
+        coalesce=getattr(args, "wire_coalesce", True))
     print(f"listening on port {bridge.port}", file=sys.stderr, flush=True)
     from kafka_ps_tpu.utils.asynclog import DeferredSink
     fabric = bridge.wrap(fabric_mod.Fabric())
@@ -456,6 +457,13 @@ def run_server(args) -> int:
     producer.run_in_background()
     bridge.wait_for_workers(workers, timeout=args.connect_timeout)
 
+    # one entry per worker that has announced READY this server
+    # lifetime: a SECOND ready from a still-ACTIVE worker is a
+    # restarted process (a member behind an aggregation relay — its
+    # death never surfaces here as a disconnect) whose in-flight
+    # weights assignment died with it
+    seen_ready: set = set()
+
     def apply_events() -> None:
         while True:
             try:
@@ -481,12 +489,31 @@ def run_server(args) -> int:
                             "all worker connections lost") from None
                     print(f"evicted worker {w} (connection lost)",
                           file=sys.stderr, flush=True)
-            elif kind == "ready" and failure_policy == "rebalance":
+            elif kind == "ready":
                 w = int(val)
-                if not server.tracker.tracker[w].active:
+                status = server.tracker.tracker[w]
+                if (failure_policy == "rebalance"
+                        and not status.active):
                     clock = server.readmit_worker(w)
+                    seen_ready.add(w)
                     print(f"readmitted worker {w} at clock {clock}",
                           file=sys.stderr, flush=True)
+                elif (w in seen_ready and status.active
+                        and status.weights_message_sent):
+                    # liveness reissue, mirroring ServerNode.
+                    # _composite_member_live: the worker process
+                    # restarted (durable state restored, so it READYs
+                    # again immediately) while its round assignment was
+                    # lost mid-flight — re-send the current weights so
+                    # the stalled gate completes.  Idempotent for
+                    # theta: a recompute yields a duplicate gradient
+                    # the clock filter already drops.
+                    server.send_weights(w, status.vector_clock)
+                    print(f"reissued weights to restarted worker {w} "
+                          f"at clock {status.vector_clock}",
+                          file=sys.stderr, flush=True)
+                else:
+                    seen_ready.add(w)
 
     # live pulse (utils/status.py): iters/s, clocks, membership, queue
     # depth — the split-mode face of `--status_every`
@@ -614,7 +641,8 @@ def run_worker(args) -> int:
         host or "127.0.0.1", int(port), ids,
         heartbeat_timeout=getattr(args, "heartbeat_timeout", None),
         codec=_codec_spec(args),
-        tracer=tracer, telemetry=telemetry)
+        tracer=tracer, telemetry=telemetry,
+        coalesce=getattr(args, "wire_coalesce", True))
     fabric = bridge.make_fabric()
     # per-process model-health plane (--model-health): each worker
     # process watches its OWN local training stream — eval rows from
@@ -908,7 +936,8 @@ def run_server_shard(args) -> int:
         port=args.listen,
         heartbeat_interval=min(1.0, hb_timeout / 3) if hb_timeout else 1.0,
         heartbeat_timeout=hb_timeout,
-        run_id=run_id, tracer=tracer, telemetry=telemetry)
+        run_id=run_id, tracer=tracer, telemetry=telemetry,
+        coalesce=getattr(args, "wire_coalesce", True))
     print(f"shard {shard_id}/{num_shards} range "
           f"[{key_range.start}, {key_range.end}) listening on port "
           f"{bridge.port}", file=sys.stderr, flush=True)
@@ -1112,7 +1141,8 @@ def run_aggregator(args) -> int:
                              or 0.002),
         heartbeat_interval=1.0,
         heartbeat_timeout=getattr(args, "heartbeat_timeout", None),
-        tracer=tracer, telemetry=telemetry)
+        tracer=tracer, telemetry=telemetry,
+        coalesce=getattr(args, "wire_coalesce", True))
     if relay.restored:
         print("restored aggregator error-feedback residuals",
               file=sys.stderr, flush=True)
@@ -1203,7 +1233,9 @@ def _run_worker_sharded(args, addrs: list[str],
                                 connect_timeout=timeout,
                                 heartbeat_timeout=getattr(
                                     args, "heartbeat_timeout", None),
-                                tracer=tracer, telemetry=telemetry)
+                                tracer=tracer, telemetry=telemetry,
+                                coalesce=getattr(
+                                    args, "wire_coalesce", True))
 
     slots: list = [connect(a) for a in addrs]
 
@@ -1262,8 +1294,52 @@ def _run_worker_sharded(args, addrs: list[str],
     buffers = {w: SlidingBuffer(cfg.model.num_features, cfg.buffer,
                                 telemetry=telemetry, worker=w)
                for w in ids}
-    log = CsvLogSink("./logs-worker.csv" if args.logging else None,
-                     WORKER_HEADER)
+
+    # worker-local durable state, exactly as in run_worker: a member
+    # process restarted WITHIN a run recovers its training window
+    # instead of cold-starting an empty buffer.  Run continuity is
+    # keyed on slots[0]'s advertised run id — one relay in aggregate
+    # mode; in sharded mode shard 0 stands in for the fleet (per-shard
+    # run ids are independent, so cross-restart state is best-effort
+    # there).
+    run_id = slots[0].server_run_id
+    state_path = None
+    restoring = False
+    if getattr(args, "checkpoint", None):
+        from kafka_ps_tpu.utils import checkpoint as ckpt
+        state_path = ckpt.worker_state_path(args.checkpoint, ids)
+        stored = ckpt.peek_run_id(state_path)
+        restoring = stored is not None and stored == run_id
+        if not restoring and os.path.exists(state_path):
+            print(f"discarding stale worker state {state_path} "
+                  f"(run {stored} != server run {run_id})",
+                  file=sys.stderr, flush=True)
+            os.remove(state_path)
+    if restoring:
+        from kafka_ps_tpu.utils import checkpoint as ckpt
+        if ckpt.maybe_restore_worker(state_path, buffers, run_id=run_id,
+                                     residuals=compressors):
+            print("restored worker buffers: " + ", ".join(
+                f"{w}:{buffers[w].count} rows (seen "
+                f"{buffers[w].num_tuples_seen})" for w in ids),
+                file=sys.stderr, flush=True)
+
+    # log continuity decided by RUN continuity, not by whether state
+    # restored (same rule as run_worker): pre-crash rows belong to this
+    # logical run even when the crash beat the first state snapshot
+    log_path = "./logs-worker.csv" if args.logging else None
+    append_log = restoring
+    if log_path is not None:
+        marker = log_path + ".runid"
+        try:
+            with open(marker) as fh:
+                append_log = append_log or (
+                    int(fh.read().strip()) == run_id)
+        except (OSError, ValueError):
+            pass
+        with open(marker, "w") as fh:
+            fh.write(str(run_id))
+    log = CsvLogSink(log_path, WORKER_HEADER, append=append_log)
     from kafka_ps_tpu.utils.asynclog import DeferredSink
     worker_log = DeferredSink(log)
     nodes = {w: WorkerNode(w, cfg, fabric, buffers[w], test_x, test_y,
@@ -1276,6 +1352,31 @@ def _run_worker_sharded(args, addrs: list[str],
         if modelhealth is not None:
             nodes[w].modelhealth = modelhealth
             buffers[w].attach_drift(modelhealth.drift)
+
+    if state_path is not None:
+        from kafka_ps_tpu.utils import checkpoint as ckpt
+        state_stop = threading.Event()
+
+        state_every = getattr(args, "state_every", 1.0)
+        if state_every is None or state_every <= 0:
+            raise SystemExit("--state_every must be > 0 (seconds between "
+                             "durable buffer snapshots)")
+
+        def state_saver():
+            # snapshot on the --state_every cadence; the fingerprint
+            # covers insertions and iterations (run_worker's rule)
+            last = None
+            while not state_stop.wait(state_every):
+                fp = (tuple(buffers[w].num_tuples_seen for w in ids),
+                      tuple(nodes[w].iterations for w in ids))
+                if fp != last:
+                    ckpt.save_worker(state_path, buffers, run_id=run_id,
+                                     residuals=compressors)
+                    last = fp
+
+        state_saver_thread = threading.Thread(
+            target=state_saver, daemon=True, name="kps-worker-state")
+        state_saver_thread.start()
 
     reader_threads: list[threading.Thread] = []
 
@@ -1398,6 +1499,19 @@ def _run_worker_sharded(args, addrs: list[str],
         t.join(timeout=120.0)
         if t.is_alive():
             leftover.append(t.name)
+    if state_path is not None:
+        from kafka_ps_tpu.utils import checkpoint as ckpt
+        state_stop.set()
+        # join BEFORE the final save: two concurrent save_worker calls
+        # share one tmp path and would corrupt the state file
+        state_saver_thread.join(timeout=60.0)
+        if state_saver_thread.is_alive():
+            print("warning: state saver still writing; skipping final "
+                  "snapshot", file=sys.stderr, flush=True)
+            leftover.append(state_saver_thread.name)
+        else:
+            ckpt.save_worker(state_path, buffers, run_id=run_id,
+                             residuals=compressors)
     worker_log.close()
     for b in slots:
         b.close()
@@ -1476,7 +1590,9 @@ def run_replica(args) -> int:
     bridge = net.ServerBridge(port=0 if port is None else port,
                               run_id=time.time_ns(), tracer=tracer,
                               telemetry=telemetry,
-                              shm=getattr(args, "serve_shm", False))
+                              shm=getattr(args, "serve_shm", False),
+                              coalesce=getattr(args, "wire_coalesce",
+                                               True))
     bridge.attach_serving(engine)
     follower.start()
     mode = (f"{follower.num_shards}-shard assembled"

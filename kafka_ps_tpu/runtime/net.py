@@ -23,8 +23,13 @@ Wire format, little-endian:
                                          CONFIG: <f64 ping_interval_s>
                                                  <i64 run_id>
                                                  [<u8 codec_id> <f32 param>];
-                                         DATA_BATCH: <i64 nrows> then per
-                                         row <i32 len><serde bytes>;
+                                         DATA_BATCH: columnar <i64 -nrows>
+                                         + packed index/value/label
+                                         columns (serde.
+                                         encode_labeled_rows); the
+                                         legacy <i64 nrows> then per row
+                                         <i32 len><serde bytes> layout
+                                         is still accepted on receive;
                                          PREDICT / PREDICTION: see the
                                          encode_/decode_ helpers below)
 `key` is the logical worker id (the Kafka record key, CsvProducer.java:61);
@@ -82,11 +87,14 @@ from kafka_ps_tpu.compress.wire import NONE as CODEC_SPEC_NONE
 from kafka_ps_tpu.compress.wire import CODEC_NONE, CodecSpec
 from kafka_ps_tpu.runtime import fabric as fabric_mod
 from kafka_ps_tpu.runtime import serde
+# the wire engine (docs/WIRE.md): coalescing writer, buffered reader,
+# scatter-gather send, and the shared frame header + force_close
+from kafka_ps_tpu.runtime.wire import (_FRAME, FrameWriter, RecvBuffer,
+                                       force_close, sendmsg_all)
 from kafka_ps_tpu.telemetry import NULL_TELEMETRY
 from kafka_ps_tpu.telemetry.flight import FLIGHT
 from kafka_ps_tpu.utils.trace import NULL_TRACER
 
-_FRAME = struct.Struct("<IBq")          # length, topic, key
 (T_WEIGHTS, T_GRADIENTS, T_DATA, T_HELLO, T_READY,
  T_PING, T_PONG, T_CONFIG, T_PREDICT, T_PREDICTION,
  T_DATA_BATCH, T_WEIGHTS_AGG) = range(1, 13)
@@ -211,8 +219,15 @@ def _encode_result(result) -> bytes:
 
 def send_frame(sock: socket.socket, topic: int, key: int,
                payload: bytes = b"") -> None:
+    """One frame, immediately (the non-queued fallback path).  Header
+    and payload go out as a two-element scatter-gather send — a
+    multi-KB weights payload is never copied just to prepend 13
+    bytes."""
     header = _FRAME.pack(_FRAME.size - 4 + len(payload), topic, key)
-    sock.sendall(header + payload)
+    if len(payload):
+        sendmsg_all(sock, (header, payload))
+    else:
+        sock.sendall(header)
 
 
 def locked_send(sock: socket.socket, lock, topic: int, key: int,
@@ -311,34 +326,28 @@ def _frame_counters(telemetry):
     return sent, recv
 
 
-def force_close(sock: socket.socket) -> None:
-    """shutdown + close: a plain close() does NOT wake a thread blocked
-    in recv() on the same socket; shutdown(SHUT_RDWR) delivers EOF to
-    it first."""
-    try:
-        sock.shutdown(socket.SHUT_RDWR)
-    except OSError:
-        pass
-    try:
-        sock.close()
-    except OSError:
-        pass
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray | bytes | None:
     """Exactly n bytes, or None on a clean EOF before the first byte.
     EOF after a partial read is a torn frame — a crashed peer, never an
     orderly shutdown — and raises so the caller treats it as a failure
-    (the reference gets this for free from Kafka's record framing)."""
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            if buf:
+    (the reference gets this for free from Kafka's record framing).
+    Preallocated bytearray filled via recv_into — no quadratic
+    `bytes += chunk` re-copy for payloads the kernel delivers in
+    pieces.  Stays as the fallback read path for the handshake and the
+    PredictClient (bridge readers use wire.RecvBuffer)."""
+    if n == 0:
+        return b""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if r == 0:
+            if got:
                 raise ConnectionError(
-                    f"mid-frame EOF ({len(buf)}/{n} bytes)")
+                    f"mid-frame EOF ({got}/{n} bytes)")
             return None
-        buf += chunk
+        got += r
     return buf
 
 
@@ -368,7 +377,8 @@ class ServerBridge:
                  heartbeat_interval: float | None = None,
                  heartbeat_timeout: float | None = None,
                  run_id: int = 0, codec: CodecSpec | None = None,
-                 tracer=None, telemetry=None, shm: bool = False):
+                 tracer=None, telemetry=None, shm: bool = False,
+                 coalesce: bool = True):
         # `run_id` identifies the logical RUN (fresh server start, or
         # the run a checkpoint resume continues — utils/checkpoint.py
         # persists it).  Advertised in T_CONFIG so worker processes can
@@ -401,6 +411,11 @@ class ServerBridge:
         self._fabric: fabric_mod.Fabric | None = None
         self._stop = threading.Event()
         self._send_lock: dict[socket.socket, OrderedLock] = {}
+        # `--wire-coalesce` (docs/WIRE.md): queue frames per connection
+        # and ship them in scatter-gather batches from a dedicated
+        # writer thread; off = the classic one-sendall-per-frame path
+        self._coalesce = bool(coalesce)
+        self._writer_of: dict[socket.socket, FrameWriter] = {}
         self._last_recv: dict[socket.socket, float] = {}
         self.on_disconnect = None   # Callable[[list[int]], None]
         self.on_hello = None        # Callable[[list[int]], None]
@@ -482,21 +497,20 @@ class ServerBridge:
 
     def send_data_batch(self, worker: int, rows) -> bool:
         """Forward N stream rows to the process hosting `worker` in ONE
-        frame: <i64 nrows> then per row <i32 len><serde bytes>.  The
-        receiver inserts them under a single buffer lock (SlidingBuffer
-        .add_many) — amortizes the per-row frame + syscall + lock cost
-        on the ingest path.  `rows` is a sequence of (features, label);
-        False exactly like send_data (the caller reroutes the rows)."""
-        from kafka_ps_tpu.runtime.messages import LabeledData
+        columnar frame: <i64 -nrows> discriminator + packed
+        feature-index/value/label ndarray columns
+        (serde.encode_labeled_rows) decoded straight into
+        SlidingBuffer.add_many — no per-row serde header, length
+        prefix, or dict rebuild on the encode side.  Receivers accept
+        the legacy per-row <i32 len><serde blob> layout too (nrows >=
+        0), so a mixed-version fleet interoperates.  `rows` is a
+        sequence of (features, label); False exactly like send_data
+        (the caller reroutes the rows)."""
         conn = self._conn_of.get(worker)
         if conn is None:
             return False
-        chunks = [struct.pack("<q", len(rows))]
-        for features, label in rows:
-            blob = serde.to_bytes(LabeledData(features, label))
-            chunks.append(struct.pack("<i", len(blob)))
-            chunks.append(blob)
-        return self._send_raw(conn, T_DATA_BATCH, worker, b"".join(chunks))
+        return self._send_raw(conn, T_DATA_BATCH, worker,
+                              serde.encode_labeled_rows(rows))
 
     def send_weights_group(self, release, builder) -> set:
         """Grouped weights fan-out for aggregator relays (the
@@ -607,6 +621,11 @@ class ServerBridge:
         # join and die inside native recv at interpreter exit)
         if self._accept_thread is not threading.current_thread():
             self._accept_thread.join(timeout=10.0)
+        # flush-before-close: writers drain their queues first, so a
+        # goodbye/CONFIG enqueued before close() reaches the wire in
+        # order; only then are the sockets torn down
+        for writer in list(self._writer_of.values()):
+            writer.close(flush=True)
         # every live connection, including ones that never sent HELLO
         for conn in list(self._send_lock):
             force_close(conn)        # wakes the blocked reader thread
@@ -658,29 +677,45 @@ class ServerBridge:
         # (PING/CONFIG) hitting a dying connection is not lost training
         # data, and neither is a prediction reply to a vanished client
         count = topic not in (T_PING, T_CONFIG, T_PREDICTION)
-        lock = self._send_lock.get(conn)
-        if lock is None:
-            self.dropped_sends += count
-            return False
-        try:
-            locked_send(conn, lock, topic, key, payload)
-            with self._wire_lock:
-                self.wire_bytes[topic] = (self.wire_bytes.get(topic, 0)
-                                          + _FRAME.size + len(payload))
-            if self._telemetry.enabled:
-                frames, nbytes = self._m_sent[topic]
-                frames.inc()
-                nbytes.inc(_FRAME.size + len(payload))
-            if FLIGHT.enabled and topic in (T_WEIGHTS, T_GRADIENTS):
-                # only the data-plane topics: a PING every few seconds
-                # would evict the interesting events from a quiet ring
-                FLIGHT.record("net.send", topic=TOPIC_NAMES[topic],
-                              peer=key, bytes=len(payload))
-            return True
-        except (ConnectionError, OSError):
-            self.dropped_sends += count
-            force_close(conn)       # wake the reader -> cleanup/eviction
-            return False
+        writer = self._writer_of.get(conn)
+        if writer is not None:
+            # coalesced path: enqueue and return — the writer thread
+            # ships batches in scatter-gather syscalls.  Wire-byte /
+            # telemetry accounting happens HERE at enqueue time, so an
+            # arm with coalescing on is number-for-number comparable to
+            # one with it off (bench wire_ab).  PINGs are advisory:
+            # regenerated next interval, so a full queue drops them
+            # (typed counter) instead of blocking the heartbeat thread.
+            if not writer.send(topic, key, payload,
+                               advisory=topic == T_PING):
+                self.dropped_sends += count
+                if writer.dead:
+                    force_close(conn)   # reader wakes -> cleanup/eviction
+                return False
+        else:
+            lock = self._send_lock.get(conn)
+            if lock is None:
+                self.dropped_sends += count
+                return False
+            try:
+                locked_send(conn, lock, topic, key, payload)
+            except (ConnectionError, OSError):
+                self.dropped_sends += count
+                force_close(conn)   # wake the reader -> cleanup/eviction
+                return False
+        with self._wire_lock:
+            self.wire_bytes[topic] = (self.wire_bytes.get(topic, 0)
+                                      + _FRAME.size + len(payload))
+        if self._telemetry.enabled:
+            frames, nbytes = self._m_sent[topic]
+            frames.inc()
+            nbytes.inc(_FRAME.size + len(payload))
+        if FLIGHT.enabled and topic in (T_WEIGHTS, T_GRADIENTS):
+            # only the data-plane topics: a PING every few seconds
+            # would evict the interesting events from a quiet ring
+            FLIGHT.record("net.send", topic=TOPIC_NAMES[topic],
+                          peer=key, bytes=len(payload))
+        return True
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -695,6 +730,9 @@ class ServerBridge:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._send_lock[conn] = OrderedLock("ServerBridge.send")
+            if self._coalesce:
+                self._writer_of[conn] = FrameWriter(
+                    conn, telemetry=self._telemetry)
             self._last_recv[conn] = time.monotonic()
             t = threading.Thread(target=self._reader, args=(conn,),
                                  daemon=True, name="kps-net-reader")
@@ -718,9 +756,13 @@ class ServerBridge:
                 self._send(conn, T_PING, 0)
 
     def _reader(self, conn: socket.socket) -> None:
+        # buffered receive (wire.RecvBuffer): one recv_into brings in
+        # every frame the kernel has ready; payloads stay zero-copy
+        # views into the buffer
+        rbuf = RecvBuffer(conn)
         try:
             while not self._stop.is_set():
-                frame = recv_frame(conn)
+                frame = rbuf.recv_frame()
                 if frame is None:
                     break
                 self._last_recv[conn] = time.monotonic()
@@ -929,6 +971,11 @@ class ServerBridge:
             conn.close()
         except OSError:
             pass
+        writer = self._writer_of.pop(conn, None)
+        if writer is not None:
+            # the connection is dead — discard the queue, don't flush
+            # (a writer mid-sendmsg fails on the closed fd and exits)
+            writer.close(flush=False, timeout=2.0)
         with self._cv:
             ids = [w for w, c in self._conn_of.items() if c is conn]
             for w in ids:
@@ -968,7 +1015,8 @@ class WorkerBridge:
                  heartbeat_timeout: float | None = None,
                  codec: CodecSpec | None = None,
                  tracer=None, telemetry=None,
-                 aggregator: bool = False):
+                 aggregator: bool = False,
+                 coalesce: bool = True):
         """`heartbeat_timeout`: seconds of total server silence before
         the connection is declared dead (only sensible when the server
         PINGs, i.e. it was built with a heartbeat_interval — otherwise a
@@ -985,7 +1033,11 @@ class WorkerBridge:
         .md): the server routes their weights/data through this
         connection, may group releases into T_WEIGHTS_AGG frames, and
         treats a disconnect as a relay restart instead of a member
-        failure."""
+        failure.
+        `coalesce`: queue outgoing frames behind a wire.FrameWriter
+        (scatter-gather batches from a dedicated writer thread,
+        docs/WIRE.md); False is the classic locked-sendall-per-frame
+        path (`--no-wire-coalesce`)."""
         self.worker_ids = list(worker_ids)
         self.aggregator = bool(aggregator)
         # relay hook (agg/relay.py): when set, run_reader hands raw
@@ -1070,6 +1122,27 @@ class WorkerBridge:
         # cadence may floor or disable it
         self._sock.settimeout(heartbeat_timeout)
         self._apply_server_ping_interval(interval)
+        # the coalescing writer starts AFTER the synchronous handshake:
+        # HELLO went out on the locked path above, and nothing else can
+        # have been enqueued yet, so per-connection frame order is
+        # preserved across the switch
+        self._writer = (FrameWriter(self._sock,
+                                    telemetry=self._telemetry)
+                        if coalesce else None)
+
+    def _enqueue(self, topic: int, key: int, payload: bytes = b"",
+                 advisory: bool = False) -> None:
+        """Send one frame via the coalescing writer when enabled, the
+        locked direct path otherwise.  A failed protocol enqueue (dead
+        writer, or the backpressure deadline expired) raises
+        ConnectionError — the exact failure surface locked_send has —
+        so caller semantics are identical on both paths."""
+        if self._writer is not None:
+            if not self._writer.send(topic, key, payload,
+                                     advisory=advisory) and not advisory:
+                raise ConnectionError("wire writer closed")
+            return
+        locked_send(self._sock, self._send_lock, topic, key, payload)
 
     def send_gradients(self, key: int, message) -> None:
         """Serialize one gradient message (full-range, or a per-shard
@@ -1090,8 +1163,7 @@ class WorkerBridge:
                     worker=getattr(message, "worker_id", key)):
                 self._tracer.flow_start("delta.wire", fid)
             payload += _TRACE_CTX.pack(fid, 0)
-        locked_send(self._sock, self._send_lock,
-                    T_GRADIENTS, key, payload)
+        self._enqueue(T_GRADIENTS, key, payload)
         with self._wire_lock:
             self.wire_bytes[T_GRADIENTS] = (
                 self.wire_bytes.get(T_GRADIENTS, 0)
@@ -1119,8 +1191,7 @@ class WorkerBridge:
                                    worker=key):
                 self._tracer.flow_start("delta.wire", fid)
             payload += _TRACE_CTX.pack(fid, 0)
-        locked_send(self._sock, self._send_lock,
-                    T_GRADIENTS, key, payload)
+        self._enqueue(T_GRADIENTS, key, payload)
         with self._wire_lock:
             self.wire_bytes[T_GRADIENTS] = (
                 self.wire_bytes.get(T_GRADIENTS, 0)
@@ -1184,16 +1255,17 @@ class WorkerBridge:
         self._sock.settimeout(effective)
 
     def mark_ready(self, worker: int) -> None:
-        locked_send(self._sock, self._send_lock, T_READY, worker)
+        self._enqueue(T_READY, worker)
 
     def run_reader(self, buffers: dict[int, object]) -> None:
         """Blocking read loop (call on a dedicated thread or the main
         thread): dispatches INPUT_DATA to `buffers[worker].add` (batched
         frames to `.add_many`) and WEIGHTS into the local fabric.
         Returns on EOF (server done)."""
+        rbuf = RecvBuffer(self._sock)
         try:
             while not self._stop.is_set():
-                frame = recv_frame(self._sock)
+                frame = rbuf.recv_frame()
                 if frame is None:
                     break
                 topic, key, payload = frame
@@ -1206,7 +1278,9 @@ class WorkerBridge:
                     frames.inc()
                     nbytes.inc(_FRAME.size + len(payload))
                 if topic == T_PING:
-                    locked_send(self._sock, self._send_lock, T_PONG, 0)
+                    # a PONG is liveness, regenerated on the next PING:
+                    # advisory — never blocks the reader on backpressure
+                    self._enqueue(T_PONG, 0, advisory=True)
                     continue
                 if topic == T_CONFIG:
                     # normally consumed by the constructor handshake;
@@ -1243,6 +1317,13 @@ class WorkerBridge:
                         continue
                 if topic == T_DATA_BATCH:
                     (nrows,) = struct.unpack_from("<q", payload, 0)
+                    if nrows < 0:
+                        # columnar layout (serde.encode_labeled_rows):
+                        # packed ndarray columns, one decode per BATCH
+                        buffers[key].add_many(
+                            serde.decode_labeled_rows(payload))
+                        continue
+                    # legacy per-row layout from an older server
                     off = 8
                     rows = []
                     for _ in range(nrows):
@@ -1276,6 +1357,10 @@ class WorkerBridge:
 
     def close(self) -> None:
         self._stop.set()
+        if self._writer is not None:
+            # flush-before-close: queued frames (a final gradient, a
+            # READY) reach the wire before the socket goes down
+            self._writer.close(flush=True)
         try:
             self._sock.close()
         except OSError:

@@ -176,6 +176,77 @@ def to_bytes(msg) -> bytes:
     raise TypeError(f"unregistered message type {type(msg).__name__}")
 
 
+# -- columnar ingest rows (T_DATA_BATCH, runtime/net.py) -------------------
+# The batched stream-row frame body.  Legacy layout: <i64 nrows> then
+# per row <i32 len> + a nested LabeledData to_bytes() blob — one magic
+# header, one dtype dispatch, and one dict build per ROW.  Columnar
+# layout (this encoder): one NEGATIVE <i64 -nrows> discriminator (the
+# legacy row count is always >= 0, so old receivers can never confuse
+# the two), then packed ndarray columns:
+#     <i64 -nrows> <i64 total_nnz>
+#     <i4 nnz[nrows]>       per-row feature counts
+#     <i64 labels[nrows]>   per-row labels (the serde header's i64 slot)
+#     <i4 keys[total_nnz]>  concatenated feature indices, row-major
+#     <f4 vals[total_nnz]>  concatenated feature values, row-major
+# Both sides of net.py accept BOTH layouts; only the sender changed.
+
+_BATCH_HEAD = struct.Struct("<qq")        # -nrows, total_nnz
+
+
+def encode_labeled_rows(rows) -> bytes:
+    """Columnar T_DATA_BATCH body for a sequence of (features: dict,
+    label: int) stream rows.  An empty sequence encodes as the legacy
+    <i64 0> frame (the -0 discriminator would be ambiguous)."""
+    n = len(rows)
+    if n == 0:
+        return struct.pack("<q", 0)
+    nnz = np.empty(n, dtype="<i4")
+    labels = np.empty(n, dtype="<q")
+    keys_cols = []
+    vals_cols = []
+    for i, (features, label) in enumerate(rows):
+        c = len(features)
+        nnz[i] = c
+        labels[i] = label
+        keys_cols.append(np.fromiter(features.keys(), dtype="<i4",
+                                     count=c))
+        vals_cols.append(np.fromiter(features.values(), dtype="<f4",
+                                     count=c))
+    keys = np.concatenate(keys_cols) if keys_cols else \
+        np.empty(0, dtype="<i4")
+    vals = np.concatenate(vals_cols) if vals_cols else \
+        np.empty(0, dtype="<f4")
+    return b"".join((_BATCH_HEAD.pack(-n, keys.size),
+                     nnz.tobytes(), labels.tobytes(),
+                     keys.tobytes(), vals.tobytes()))
+
+
+def decode_labeled_rows(payload) -> list:
+    """Decode a columnar T_DATA_BATCH body (negative-nrows layout)
+    back into [(features, label), ...] — the exact rows add_many
+    inserts, with Python int keys / float values like the legacy
+    per-row LabeledData decode."""
+    neg, total = _BATCH_HEAD.unpack_from(payload, 0)
+    n = -neg
+    off = _BATCH_HEAD.size
+    nnz = np.frombuffer(payload, dtype="<i4", offset=off, count=n)
+    off += 4 * n
+    labels = np.frombuffer(payload, dtype="<q", offset=off, count=n)
+    off += 8 * n
+    keys = np.frombuffer(payload, dtype="<i4", offset=off, count=total)
+    off += 4 * total
+    vals = np.frombuffer(payload, dtype="<f4", offset=off, count=total)
+    ks, vs = keys.tolist(), vals.tolist()
+    rows = []
+    pos = 0
+    for i in range(n):
+        c = int(nnz[i])
+        rows.append((dict(zip(ks[pos:pos + c], vs[pos:pos + c])),
+                     int(labels[i])))
+        pos += c
+    return rows
+
+
 def from_bytes(payload: bytes):
     magic, tid, clock_or_label = _HEADER.unpack_from(payload, 0)
     if magic != MAGIC:

@@ -70,7 +70,9 @@ def _scalar(v):
 # summary-line layout is handled by prefixing ("summary",).
 # direction: "higher" | "lower";  rel = relative tolerance vs the best
 # baseline;  cap = absolute ceiling checked on the fresh file alone;
-# must_be_true = bitwise/bool contract on the fresh file alone.
+# floor = absolute lower bound checked on the fresh file alone (the
+# mirror of cap, for throughput/ratio claims with a hard acceptance
+# bar);  must_be_true = bitwise/bool contract on the fresh file alone.
 METRICS = {
     "worker_updates_per_sec": {
         "paths": [("value",)], "direction": "higher", "rel": 0.15},
@@ -125,6 +127,22 @@ METRICS = {
                    "updates_per_sec_scaling"),
                   ("agg_updates_per_sec_scaling",)],
         "direction": "higher", "rel": 0.25},
+    # wire engine (docs/WIRE.md): the coalesced path must stay bitwise,
+    # actually batch frames into scatter-gather syscalls (>= 2.0 median
+    # frames/sendmsg at the 64-worker/4-relay fan-out), and never lose
+    # throughput to the un-coalesced path
+    "wire_bitwise": {
+        "paths": [("detail", "paths", "wire_ab", "all_bitwise"),
+                  ("wire_bitwise",)],
+        "must_be_true": True},
+    "wire_fps_p50": {
+        "paths": [("detail", "paths", "wire_ab",
+                   "frames_per_syscall_p50"), ("wire_fps_p50",)],
+        "direction": "higher", "floor": 2.0, "rel": 0.5},
+    "wire_updates_ratio": {
+        "paths": [("detail", "paths", "wire_ab", "updates_ratio_best"),
+                  ("wire_updates_ratio",)],
+        "direction": "higher", "floor": 1.0, "rel": 0.25},
     # absolute caps — the observability planes' cost contracts
     "telemetry_overhead_pct": {
         "paths": [("detail", "paths", "telemetry_overhead",
@@ -291,6 +309,10 @@ def run_gate(fresh_path: str, baseline_paths: list[str],
         if cap is not None and isinstance(val, float) and val >= cap:
             fail(f"{val} >= cap {cap}")
             continue
+        floor = spec.get("floor")
+        if floor is not None and isinstance(val, float) and val < floor:
+            fail(f"{val} < floor {floor}")
+            continue
 
         # best comparable baseline value for this key
         cands = []
@@ -319,6 +341,9 @@ def run_gate(fresh_path: str, baseline_paths: list[str],
         if not cands or not isinstance(val, float):
             if cap is not None:
                 print(f"bench-gate: ok {key}={val} (cap {cap}, no "
+                      "comparable baseline)", file=out)
+            elif floor is not None:
+                print(f"bench-gate: ok {key}={val} (floor {floor}, no "
                       "comparable baseline)", file=out)
             else:
                 print(f"bench-gate: SKIP {key} — no comparable "

@@ -41,6 +41,16 @@
 # stripped) bitwise-equal to a direct no-relay fleet with the same
 # flags (AGG_SMOKE_OK).
 #
+# `scripts/tier1.sh --wire` runs the wire-engine smoke leg
+# (docs/WIRE.md): a socket fleet of 1 server (--bsp-order) + 1
+# aggregator relay + 2 member worker processes (4 logical workers) runs
+# twice — frame coalescing on (default) vs --no-wire-coalesce.  In EACH
+# arm one member worker process is SIGKILL'd mid-run and restarted
+# (durable worker state + relay weights stash + the server's READY
+# liveness reissue recover the stalled round), and final theta AND the
+# server eval CSV (timestamps stripped) must be bitwise-equal across
+# the coalescing lever (WIRE_SMOKE_OK).
+#
 # `scripts/tier1.sh --load` runs the serving-load smoke leg: a child
 # training process serving over a socket (--serve --serve_port
 # --serve-queue) driven by THIS process's load generator — zero
@@ -483,7 +493,11 @@ def free_port():
 
 env = dict(os.environ, JAX_PLATFORMS="cpu",
            PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
-MAX_IT = 200
+# 2000 rounds keep the training window open for seconds (the eval CSV
+# is drained asynchronously, so a 200-round run can be over before any
+# on-disk row count triggers the mid-run kill — the restarted relay
+# then dials a server that already exited)
+MAX_IT = 2000
 # 128 rows / 4 workers = 32 per partition = the buffer cap, so
 # --ready-rows 32 means "my whole partition arrived" — ingestion fully
 # precedes training in both arms, which removes stream timing from the
@@ -603,6 +617,169 @@ assert csv_rows(acwd) == csv_rows(dcwd) != [], \
     "aggregated eval CSV diverged from the direct run"
 print(f"AGG_SMOKE_OK relays=2 workers=4 iters={MAX_IT} "
       f"kill=relay0+restart theta=bitwise csv=bitwise")
+EOF
+    exit $?
+fi
+
+if [[ "${1:-}" == "--wire" ]]; then
+    timeout -k 10 540 env JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+# the wire-engine A/B (docs/WIRE.md): the SAME training run — server
+# <-- 1 relay <-- 2 member worker processes (4 logical workers) — once
+# with frame coalescing on (the default) and once with
+# --no-wire-coalesce, deterministic knobs as in the --agg leg
+# (--bsp-order, --ready-rows = full partition).  In EACH arm one member
+# worker process is SIGKILL'd mid-run and restarted: its durable state
+# (--checkpoint/--state_every, cli/socket_mode._run_worker_sharded)
+# restores the frozen ingestion window, the relay redelivers its
+# stashed weights on the re-HELLO, and the server's READY liveness
+# reissue re-sends the in-flight round assignment — so the stalled BSP
+# gate completes with a bit-identical applied-gradient sequence no
+# matter when the kill landed.  Final theta and the server eval CSV
+# must match bitwise across the coalescing lever.
+root = tempfile.mkdtemp(prefix="kps-wire-")
+repo = os.getcwd()
+rng = np.random.default_rng(0)
+x = rng.normal(size=(192, 8)).astype(np.float32)
+y = (x[:, 0] > 0).astype(np.int32) + 1
+train, test = os.path.join(root, "train.csv"), os.path.join(root, "test.csv")
+for path, (xx, yy) in ((train, (x[:128], y[:128])),
+                       (test, (x[128:], y[128:]))):
+    with open(path, "w") as fh:
+        fh.write(",".join(f"f{i}" for i in range(8)) + ",Score\n")
+        for r, lab in zip(xx, yy):
+            fh.write(",".join(f"{v:.6f}" for v in r) + f",{lab}\n")
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+# 2000 rounds keep the training window open for seconds (the eval CSV
+# is drained asynchronously, so a 200-round run is over before any
+# on-disk row count can trigger a mid-run kill)
+MAX_IT = 2000
+READY = 32          # 128 rows / 4 workers: full-partition gating
+common = ["--num_workers", "4", "--num_features", "8",
+          "--num_classes", "2", "--max_iterations", str(MAX_IT)]
+
+def server_proc(cwd, port, wire):
+    return subprocess.Popen(
+        [sys.executable, "-m", "kafka_ps_tpu.cli.server_runner",
+         "--listen", str(port), "--bsp-order", "-c", "0",
+         "-training", train, "-test", test, "-p", "1", "--logging",
+         "--checkpoint", os.path.join(cwd, "ckpt.npz"), wire, *common],
+        env=env, cwd=cwd, stderr=subprocess.PIPE,
+        stdout=subprocess.DEVNULL, text=True)
+
+def worker_proc(cwd, wids, aport, wire):
+    return subprocess.Popen(
+        [sys.executable, "-m", "kafka_ps_tpu.cli.worker_runner",
+         "--aggregate", f"127.0.0.1:{aport}", "--worker_ids", wids,
+         "-test", test, "-min", "8", "-max", "32",
+         "--ready-rows", str(READY),
+         "--checkpoint", os.path.join(cwd, "job.npz"),
+         "--state_every", "0.2", wire, *common],
+        env=env, cwd=cwd, stderr=subprocess.PIPE,
+        stdout=subprocess.DEVNULL, text=True)
+
+def agg_proc(cwd, sport, aport, wire):
+    return subprocess.Popen(
+        [sys.executable, "-m", "kafka_ps_tpu.cli.agg_runner",
+         "--connect", f"127.0.0.1:{sport}", "--listen", str(aport),
+         "--agg-id", "0", "--worker_ids", "0,1,2,3", wire, *common],
+        env=env, cwd=cwd, stderr=subprocess.PIPE,
+        stdout=subprocess.DEVNULL, text=True)
+
+def finish(procs, deadline_s=240):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if all(p.poll() is not None for p in procs.values()):
+            break
+        time.sleep(0.25)
+    else:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for name, p in procs.items():
+            print(f"== {name} rc={p.poll()}\n{p.stderr.read()[-4000:]}",
+                  file=sys.stderr)
+        raise SystemExit("fleet did not finish in time")
+    bad = []
+    for name, p in procs.items():
+        err = p.stderr.read()
+        if p.returncode != 0:
+            print(f"== {name} rc={p.returncode}\n{err[-4000:]}",
+                  file=sys.stderr)
+            bad.append(name)
+    assert not bad, f"{bad} failed"
+
+def csv_rows(cwd):
+    # column 0 is the wall-clock timestamp — the only legal difference
+    with open(os.path.join(cwd, "logs-server.csv")) as fh:
+        return [";".join(ln.split(";")[1:]) for ln in fh.read().splitlines()]
+
+def run_arm(tag, wire):
+    cwd = os.path.join(root, tag)
+    os.makedirs(cwd, exist_ok=True)
+    sport, aport = free_port(), free_port()
+    sp = server_proc(cwd, sport, wire)
+    rp = agg_proc(cwd, sport, aport, wire)
+    w01 = worker_proc(cwd, "0,1", aport, wire)
+    w23 = worker_proc(cwd, "2,3", aport, wire)
+    # SIGKILL member process 2,3 once the server shows real progress
+    csv_path = os.path.join(cwd, "logs-server.csv")
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            with open(csv_path) as fh:
+                n = sum(1 for _ in fh) - 1
+        except OSError:
+            n = 0
+        if n >= 16:
+            break
+        for name, p in (("server", sp), ("relay", rp), ("w23", w23)):
+            if p.poll() is not None:
+                print(p.stderr.read(), file=sys.stderr)
+                raise SystemExit(f"{tag}: {name} exited before the kill")
+        time.sleep(0.05)
+    else:
+        raise SystemExit(f"{tag}: server never made progress")
+    os.kill(w23.pid, signal.SIGKILL)
+    w23.wait()
+    time.sleep(0.5)
+    # restart: durable state restores the 32-row windows, READY fires
+    # immediately, the stalled round completes
+    w23b = worker_proc(cwd, "2,3", aport, wire)
+    finish({"server": sp, "relay": rp, "worker01": w01,
+            "worker23-restarted": w23b})
+    return cwd
+
+cwd_on = run_arm("coalesce-on", "--wire-coalesce")
+cwd_off = run_arm("coalesce-off", "--no-wire-coalesce")
+
+zon = np.load(os.path.join(cwd_on, "ckpt.npz"))
+zoff = np.load(os.path.join(cwd_off, "ckpt.npz"))
+assert int(zon["iterations"]) >= MAX_IT <= int(zoff["iterations"])
+assert zon["theta"].tobytes() == zoff["theta"].tobytes(), \
+    "coalesced theta diverged from the --no-wire-coalesce arm"
+assert csv_rows(cwd_on) == csv_rows(cwd_off) != [], \
+    "coalesced eval CSV diverged from the --no-wire-coalesce arm"
+print(f"WIRE_SMOKE_OK workers=4 relay=1 iters={MAX_IT} "
+      f"kill=worker23+restart theta=bitwise csv=bitwise")
 EOF
     exit $?
 fi

@@ -1,0 +1,8 @@
+"""PS104 positive fixture (scoped: runtime/wire.py): stamping a flush
+batch with the wall clock — a replayed run would batch identical frames
+under different stamps, breaking the bitwise coalesce-on/off pin."""
+import time
+
+
+def stamp_flush(batch):
+    return (time.time(), batch)

@@ -1,0 +1,7 @@
+"""PS104 negative fixture (scoped: runtime/wire.py): flush batches are
+identified by a caller-owned sequence number, never a clock read at
+flush time."""
+
+
+def stamp_flush(batch, seqno):
+    return (seqno, batch)

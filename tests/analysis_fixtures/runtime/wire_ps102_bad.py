@@ -1,0 +1,10 @@
+"""PS102 positive fixture: the buffered reader materializes a frame
+body with numpy inside its per-frame parse loop — one D2H-shaped copy
+per frame on every connection."""
+import numpy as np
+
+
+class Reader:
+    def recv_frame(self):
+        body = self._view[self._pos:self._end]
+        return np.asarray(body)

@@ -1,0 +1,8 @@
+"""PS102 negative fixture: the parse loop hands out a zero-copy
+memoryview; decoding happens at the decode site, outside the per-frame
+handler."""
+
+
+class Reader:
+    def recv_frame(self):
+        return self._view[self._pos:self._end]

@@ -1,0 +1,11 @@
+"""PS105 positive fixture: the wire writer ships its batch while still
+holding the queue lock — every producer blocked on the append stalls
+behind the peer's receive window."""
+
+
+class Writer:
+    def _drain(self):
+        with self._queue_lock:
+            batch = list(self._q)
+            self._q.clear()
+            self._sock.sendmsg(batch)

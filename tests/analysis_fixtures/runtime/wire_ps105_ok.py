@@ -1,0 +1,11 @@
+"""PS105 negative fixture: the FrameWriter shape — pop the batch under
+the queue lock, ship it outside (runtime/wire.py `_pop_batch` /
+`_drain`)."""
+
+
+class Writer:
+    def _drain(self):
+        with self._queue_lock:
+            batch = list(self._q)
+            self._q.clear()
+        self._sock.sendmsg(batch)

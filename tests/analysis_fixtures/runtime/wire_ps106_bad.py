@@ -1,0 +1,7 @@
+"""PS106 positive fixture: the flush ratio is coerced inside the
+histogram call's arguments — instrumentation must observe host scalars
+the flush loop already owns."""
+
+
+def _observe_flush(hist, ratio_dev):
+    hist.observe(float(ratio_dev))

@@ -1,0 +1,6 @@
+"""PS106 negative fixture: the flush ratio is plain host-int
+arithmetic — nothing syncs inside the instrumentation call."""
+
+
+def _observe_flush(hist, nframes, syscalls):
+    hist.observe(nframes / max(syscalls, 1))

@@ -49,6 +49,10 @@ def _findings(relpath: str):
     ("agg/ps104_bad.py", "PS104"),
     ("agg/ps105_bad.py", "PS105"),
     ("agg/ps106_bad.py", "PS106"),
+    ("runtime/wire_ps102_bad.py", "PS102"),
+    ("ps104_wire_bad/runtime/wire.py", "PS104"),
+    ("runtime/wire_ps105_bad.py", "PS105"),
+    ("runtime/wire_ps106_bad.py", "PS106"),
 ])
 def test_positive_fixture_triggers_exactly_once(relpath, rule):
     found = _findings(relpath)
@@ -80,6 +84,10 @@ def test_positive_fixture_triggers_exactly_once(relpath, rule):
     "agg/ps104_ok.py",
     "agg/ps105_ok.py",
     "agg/ps106_ok.py",
+    "runtime/wire_ps102_ok.py",
+    "ps104_wire_ok/runtime/wire.py",
+    "runtime/wire_ps105_ok.py",
+    "runtime/wire_ps106_ok.py",
 ])
 def test_negative_fixture_stays_clean(relpath):
     assert _findings(relpath) == []

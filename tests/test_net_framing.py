@@ -693,6 +693,355 @@ def test_shm_falls_back_mid_flight():
         engine.close()
 
 
+# -- wire engine: coalescing writer + buffered receive (runtime/wire.py,
+# docs/WIRE.md) --------------------------------------------------------------
+
+
+from kafka_ps_tpu.runtime import wire
+
+
+class _BytesSock:
+    """recv_into-only test double serving a fixed byte string."""
+
+    def __init__(self, data: bytes):
+        self._data = memoryview(data)
+        self._off = 0
+
+    def recv_into(self, view) -> int:
+        n = min(len(view), len(self._data) - self._off)
+        view[:n] = self._data[self._off:self._off + n]
+        self._off += n
+        return n
+
+
+class _StallSock:
+    """sendall-only double (no sendmsg -> exercises the join fallback)
+    that blocks every send until released."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.sent: list[bytes] = []
+
+    def sendall(self, data) -> None:
+        self.release.wait()
+        self.sent.append(bytes(data))
+
+    def shutdown(self, how) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class _DeadSock:
+    def __init__(self):
+        self.closed = False
+
+    def sendall(self, data) -> None:
+        raise ConnectionError("peer gone")
+
+    def shutdown(self, how) -> None:
+        pass
+
+    def close(self) -> None:
+        self.closed = True
+
+
+def _drain_raw(sock):
+    """Background reader returning ([]-accumulating chunks, thread)."""
+    chunks: list[bytes] = []
+
+    def run():
+        while True:
+            try:
+                d = sock.recv(1 << 16)
+            except OSError:
+                break
+            if not d:
+                break
+            chunks.append(d)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return chunks, t
+
+
+def _random_frames(seed: int = 7, n: int = 40):
+    """Randomized frame sequence: every topic, sizes 0..1MB."""
+    rng = np.random.default_rng(seed)
+    topics = sorted(v for k, v in vars(net).items()
+                    if k.startswith("T_") and isinstance(v, int))
+    sizes = [0, 1, 12, 13, 1 << 20]     # edges incl. a 1 MB body
+    sizes += [int(s) for s in rng.integers(0, 1 << 16, n - len(sizes))]
+    frames = []
+    for i, size in enumerate(sizes):
+        payload = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        key = int(rng.integers(-(1 << 40), 1 << 40))
+        frames.append((topics[i % len(topics)], key, payload))
+    return frames
+
+
+def test_wire_roundtrip_property():
+    """The coalescing writer's byte stream is identical to sequential
+    send_frame output, and RecvBuffer parses it back frame-for-frame —
+    the bitwise coalesce-on/off contract at the transport layer."""
+    frames = _random_frames()
+
+    a, b = _pair()
+    chunks, t = _drain_raw(b)
+    for topic, key, payload in frames:
+        net.send_frame(a, topic, key, payload)
+    a.close()
+    t.join(timeout=30.0)
+    sequential = b"".join(chunks)
+    b.close()
+
+    a2, b2 = _pair()
+    chunks2, t2 = _drain_raw(b2)
+    writer = wire.FrameWriter(a2)
+    for topic, key, payload in frames:
+        assert writer.send(topic, key, payload)
+    writer.close(flush=True)
+    a2.close()
+    t2.join(timeout=30.0)
+    coalesced = b"".join(chunks2)
+    b2.close()
+
+    assert coalesced == sequential
+
+    rbuf = wire.RecvBuffer(_BytesSock(coalesced))
+    for topic, key, payload in frames:
+        got = rbuf.recv_frame()
+        assert got is not None
+        gt, gk, gp = got
+        assert (gt, gk) == (topic, key)
+        assert isinstance(gp, memoryview)
+        assert bytes(gp) == payload
+    assert rbuf.recv_frame() is None    # clean EOF at a frame boundary
+
+
+def test_wire_concurrent_enqueue_no_interleave():
+    """Two threads enqueueing concurrently: every received frame's body
+    is intact (derived from its key) and each thread's frames arrive in
+    its send order."""
+    a, b = _pair()
+    writer = wire.FrameWriter(a)
+    got: list[tuple[int, int, bytes]] = []
+
+    def read():
+        rbuf = wire.RecvBuffer(b)
+        while True:
+            f = rbuf.recv_frame()
+            if f is None:
+                return
+            got.append((f[0], f[1], bytes(f[2])))
+
+    reader = threading.Thread(target=read, daemon=True)
+    reader.start()
+
+    def produce(tid: int):
+        for i in range(300):
+            key = tid * 1000 + i
+            payload = key.to_bytes(8, "little") * ((i % 32) + 1)
+            assert writer.send(net.T_DATA, key, payload)
+
+    threads = [threading.Thread(target=produce, args=(t,))
+               for t in (1, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    writer.close(flush=True)
+    a.close()
+    reader.join(timeout=30.0)
+    b.close()
+
+    assert len(got) == 600
+    per_thread: dict[int, list[int]] = {1: [], 2: []}
+    for topic, key, payload in got:
+        assert topic == net.T_DATA
+        i = key % 1000
+        assert payload == key.to_bytes(8, "little") * ((i % 32) + 1)
+        per_thread[key // 1000].append(i)
+    assert per_thread[1] == list(range(300))    # per-producer FIFO
+    assert per_thread[2] == list(range(300))
+
+
+def test_wire_backpressure_protocol_blocks_with_deadline():
+    sock = _StallSock()
+    writer = wire.FrameWriter(sock, max_bytes=1100, send_deadline=0.25)
+    p = b"x" * 1000
+    assert writer.send(net.T_WEIGHTS, 1, p)
+    deadline = time.monotonic() + 10.0
+    while writer._qbytes and time.monotonic() < deadline:
+        time.sleep(0.005)               # writer popped, now stalled
+    assert writer.send(net.T_WEIGHTS, 2, p)     # fills the queue
+    t0 = time.monotonic()
+    assert not writer.send(net.T_WEIGHTS, 3, p)     # deadline expiry
+    elapsed = time.monotonic() - t0
+    assert 0.2 <= elapsed < 5.0
+    sock.release.set()
+    writer.close(flush=True)
+    assert b"".join(sock.sent).count(p) == 2    # 1 and 2 shipped, 3 not
+
+
+def test_wire_backpressure_advisory_typed_drop():
+    sock = _StallSock()
+    writer = wire.FrameWriter(sock, max_bytes=1100, send_deadline=5.0)
+    p = b"x" * 1000
+    assert writer.send(net.T_WEIGHTS, 1, p)
+    deadline = time.monotonic() + 10.0
+    while writer._qbytes and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert writer.send(net.T_WEIGHTS, 2, p)
+    t0 = time.monotonic()
+    assert not writer.send(net.T_PING, 0, b"y" * 200, advisory=True)
+    assert time.monotonic() - t0 < 1.0          # immediate, no wait
+    assert writer.advisory_dropped == 1
+    sock.release.set()
+    writer.close(flush=True)
+
+
+def test_wire_flush_before_close():
+    """Frames enqueued before close(flush=True) all reach the wire —
+    the goodbye/CONFIG ordering guarantee."""
+    a, b = _pair()
+    writer = wire.FrameWriter(a)
+    for i in range(50):
+        assert writer.send(net.T_CONFIG, i, struct.pack("<dq", 0.0, i))
+    writer.close(flush=True)
+    a.close()
+    rbuf = wire.RecvBuffer(b)
+    for i in range(50):
+        topic, key, payload = rbuf.recv_frame()
+        assert (topic, key) == (net.T_CONFIG, i)
+    assert rbuf.recv_frame() is None
+    b.close()
+
+
+def test_wire_writer_death_marks_dead_and_closes_socket():
+    sock = _DeadSock()
+    writer = wire.FrameWriter(sock)
+    writer.send(net.T_WEIGHTS, 1, b"abc")
+    deadline = time.monotonic() + 10.0
+    while not writer.dead and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert writer.dead
+    assert sock.closed                  # reader side woken for cleanup
+    assert not writer.send(net.T_WEIGHTS, 2, b"def")
+    writer.close(flush=True)
+
+
+def test_wire_frames_per_syscall_histogram():
+    from kafka_ps_tpu.telemetry import Telemetry
+
+    telemetry = Telemetry()
+    sock = _StallSock()
+    writer = wire.FrameWriter(sock, telemetry=telemetry)
+    assert writer.send(net.T_WEIGHTS, 0, b"w")
+    deadline = time.monotonic() + 10.0
+    while writer._qbytes and time.monotonic() < deadline:
+        time.sleep(0.005)               # flush 1 in flight, stalled
+    for i in range(9):
+        assert writer.send(net.T_GRADIENTS, i, b"g")    # queue behind it
+    sock.release.set()
+    writer.close(flush=True)
+    h = telemetry.histogram("wire_frames_per_syscall")
+    s = h.summary()
+    assert s["count"] == 2              # two flushes
+    assert s["sum"] == pytest.approx(10.0)      # ratios 1 + 9
+
+
+def test_recv_buffer_mid_frame_eof_raises():
+    header = struct.pack("<I", 32) + b"\x01\x00\x00"    # truncated
+    rbuf = wire.RecvBuffer(_BytesSock(header))
+    with pytest.raises(ConnectionError, match="mid-frame"):
+        rbuf.recv_frame()
+
+
+def test_recv_buffer_grows_past_chunk_size():
+    """A frame bigger than the buffer chunk forces a grow-and-refill."""
+    payload = bytes(range(256)) * 1024          # 256 KB >> 4 KB chunk
+    a, b = _pair()
+    chunks, t = _drain_raw(b)
+    net.send_frame(a, net.T_WEIGHTS, 5, payload)
+    a.close()
+    t.join(timeout=30.0)
+    b.close()
+    rbuf = wire.RecvBuffer(_BytesSock(b"".join(chunks)), chunk=4096)
+    topic, key, got = rbuf.recv_frame()
+    assert (topic, key) == (net.T_WEIGHTS, 5)
+    assert bytes(got) == payload
+    assert rbuf.recv_frame() is None
+
+
+# -- columnar ingest frame (serde.encode_labeled_rows) -----------------------
+
+
+def test_columnar_rows_roundtrip():
+    rows = [({0: 1.5, 7: -2.0}, 1), ({}, 0), ({3: 0.25}, 4)]
+    body = serde.encode_labeled_rows(rows)
+    assert serde.decode_labeled_rows(body) == rows
+    (nrows,) = struct.unpack_from("<q", body, 0)
+    assert nrows == -3                  # sign bit = columnar marker
+
+
+def test_columnar_empty_batch_is_legacy_zero():
+    assert serde.encode_labeled_rows([]) == struct.pack("<q", 0)
+
+
+def test_legacy_per_row_batch_accepted_on_receive():
+    """A T_DATA_BATCH in the old per-row <i32 len><serde blob> layout
+    (an older server) must still bulk-insert on a new worker."""
+    from kafka_ps_tpu.data.buffer import SlidingBuffer
+    from kafka_ps_tpu.runtime.messages import LabeledData
+    from kafka_ps_tpu.utils.config import BufferConfig
+
+    rows = [({0: 2.0}, 1), ({1: 3.0}, 0)]
+    parts = [struct.pack("<q", len(rows))]
+    for feats, label in rows:
+        blob = serde.to_bytes(LabeledData(features=feats, label=label))
+        parts.append(struct.pack("<i", len(blob)))
+        parts.append(blob)
+    legacy_body = b"".join(parts)
+
+    bridge = net.ServerBridge()
+    worker = net.WorkerBridge("127.0.0.1", bridge.port, [2])
+    bridge.wait_for_connected([2], timeout=10.0)
+    buffers = {2: SlidingBuffer(4, BufferConfig(min_size=4, max_size=16))}
+    t = threading.Thread(target=worker.run_reader, args=(buffers,),
+                         daemon=True)
+    t.start()
+    conn = bridge._conn_of[2]
+    assert bridge._send_raw(conn, net.T_DATA_BATCH, 2, legacy_body)
+    deadline = time.monotonic() + 10.0
+    while buffers[2].count < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert buffers[2].count == 2
+    worker.close(), bridge.close()
+    t.join(timeout=10.0)
+
+
+def test_bridges_expose_coalesce_lever():
+    """coalesce=False restores the per-frame locked_send path on both
+    bridges (the --no-wire-coalesce arm) — no writer objects exist."""
+    bridge = net.ServerBridge(coalesce=False)
+    worker = net.WorkerBridge("127.0.0.1", bridge.port, [1],
+                              coalesce=False)
+    bridge.wait_for_connected([1], timeout=10.0)
+    assert bridge._writer_of == {}
+    assert worker._writer is None
+    assert bridge.send_data(1, {0: 1.0}, 1)     # sends still work
+    worker.close(), bridge.close()
+
+    bridge2 = net.ServerBridge()                # default: coalescing on
+    worker2 = net.WorkerBridge("127.0.0.1", bridge2.port, [1])
+    bridge2.wait_for_connected([1], timeout=10.0)
+    assert len(bridge2._writer_of) == 1
+    assert worker2._writer is not None
+    worker2.close(), bridge2.close()
+
+
 def test_shm_channel_rejects_foreign_and_oversized():
     """Channel-level guards: nonce mismatch is a typed ShmError (name
     collision protection), oversized payloads refuse before writing."""
