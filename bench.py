@@ -28,6 +28,9 @@ Paths measured:
   * pallas fused local-update kernel vs the XLA path (A/B)
   * per-node (message-driven) runtime at eval_every=1 (reference
     cadence) and eval_every=10 (the throughput/cadence trade-off knob)
+  * async eval engine A/B (docs/EVALUATION.md): fused apply+eval vs
+    the deferred coalescing engine at eval_every=1 — bitwise rows and
+    theta (durable-log restart included) plus the apply-path speedup
   * serving plane A/B (docs/SERVING.md): batched vs unbatched
     prediction under concurrent load — dispatches/request and p50/p99
   * roofline block (docs/ROOFLINE.md): analytic FLOPs/bytes per update,
@@ -71,6 +74,7 @@ KNOWN_BLOCKS = (
     "aggregation_ab",
     "wire_ab",
     "sharding_ab",
+    "eval_ab",
     "slab_ab",
     "tiering_ab",
     "telemetry_overhead",
@@ -1322,6 +1326,159 @@ def sharding_ab(rounds: int = 120, warm: int = 24,
             "n4_speedup": speedups, "n4_speedup_best": best}
 
 
+def eval_ab(iters: int = 40, trials: int = 7,
+            bitwise_iters: int = 40) -> dict:
+    """Async coalescing eval engine A/B (evaluation/engine.py,
+    docs/EVALUATION.md "Async evaluation") at the reference cadence
+    eval_every=1, two parts.
+
+    Correctness: for all three consistency models the async arm's
+    final theta AND its eval CSV rows (wall-clock timestamp column
+    stripped) must be BITWISE-identical to the fused _apply_full_eval
+    arm's — and stay so across an in-process durable-log crash +
+    full-replay restart (the engine holds no durable state: pending
+    evals die with the process and replay re-derives the exact row
+    sequence through the same clock-ordered emission point).
+
+    Throughput: server iters/s on the reference model (6150 params)
+    at eval_every=1, fused vs async, trials interleaved.  The async
+    arm's timed window covers the apply path while the engine
+    evaluates coalesced batches on its own thread; run_serial drains
+    the engine before returning, so every trial ends at
+    eval_lag_clocks == 0 and the measured rate is steady state, not
+    deferral.  The speedup is gated (scripts/bench_gate.py: floor 1.0
+    — the async lever may never LOSE throughput — plus the relative
+    band against committed baselines of the same device class)."""
+    import tempfile
+
+    from kafka_ps_tpu.data.synth import generate_hard
+    from kafka_ps_tpu.log import DurableFabric, LogConfig
+    from kafka_ps_tpu.runtime.app import StreamingPSApp
+    from kafka_ps_tpu.utils.config import (BufferConfig, ModelConfig,
+                                           PSConfig, StreamConfig)
+
+    # -- part 1: bitwise contract at small shapes --------------------------
+    def small_cfg(c: int, eval_async: bool) -> PSConfig:
+        return PSConfig(num_workers=4, consistency_model=c,
+                        model=ModelConfig(num_features=8, num_classes=2,
+                                          local_learning_rate=0.5),
+                        buffer=BufferConfig(min_size=8, max_size=32),
+                        stream=StreamConfig(time_per_event_ms=1.0),
+                        eval_every=1, eval_async=eval_async)
+
+    rng = np.random.default_rng(7)
+    sx = rng.normal(size=(128, 8)).astype(np.float32)
+    sy = (sx[:, 0] > 0).astype(np.int32) + 1
+
+    def strip(rows: list) -> list:
+        return [";".join(r.split(";")[1:]) for r in rows]
+
+    def drive(c: int, eval_async: bool, fabric=None, upto=bitwise_iters,
+              crash=False):
+        rows: list = []
+        app = StreamingPSApp(small_cfg(c, eval_async), test_x=sx,
+                             test_y=sy, server_log=rows.append,
+                             fabric=fabric)
+        for i in range(128):
+            app.data_sink(i % 4, dict(enumerate(map(float, sx[i]))),
+                          int(sy[i]))
+        app.run_serial(upto)
+        if not crash:
+            app.close_logs()      # joins the engine thread
+        return app, rows
+
+    bitwise = {}
+    fused_rows0 = fused_theta0 = None
+    for c in (0, 2, -1):
+        fa, fr = drive(c, False)
+        aa, ar = drive(c, True)
+        ok = (np.asarray(fa.server.theta).tobytes()
+              == np.asarray(aa.server.theta).tobytes()
+              and strip(fr) == strip(ar) and len(fr) > 0)
+        bitwise[str(c)] = bool(ok)
+        if c == 0:
+            fused_rows0 = strip(fr)
+            fused_theta0 = np.asarray(fa.server.theta).tobytes()
+    assert all(bitwise.values()), \
+        f"eval_ab: async arm diverged from fused {bitwise}"
+
+    # crash + full-replay restart under the async engine: no checkpoint
+    # (the engine adds no durable state), the commit log alone must
+    # re-derive the fused arm's exact row sequence
+    with tempfile.TemporaryDirectory() as td:
+        drive(0, True, fabric=DurableFabric(td, LogConfig(fsync="none")),
+              upto=bitwise_iters // 2, crash=True)   # abandoned: SIGKILL
+        rows2: list = []
+        app2 = StreamingPSApp(small_cfg(0, True), test_x=sx, test_y=sy,
+                              server_log=rows2.append,
+                              fabric=DurableFabric(td,
+                                                   LogConfig(fsync="none")))
+        app2.recover_durable()
+        app2.run_serial(bitwise_iters)
+        app2.close_logs()
+        restart_bitwise = bool(
+            np.asarray(app2.server.theta).tobytes() == fused_theta0
+            and strip(rows2) == fused_rows0)
+    assert restart_bitwise, \
+        "eval_ab: durable-log restart diverged from fused run"
+
+    # -- part 2: apply-path throughput at the reference shape --------------
+    num_workers, cap = 4, 256
+    model = ModelConfig()
+    hx, hy = generate_hard(num_workers * cap + 2000, seed=31)
+
+    def build(eval_async: bool):
+        pcfg = PSConfig(num_workers=num_workers, consistency_model=0,
+                        model=model, eval_every=1,
+                        buffer=BufferConfig(max_size=cap),
+                        eval_async=eval_async)
+        app = StreamingPSApp(pcfg, test_x=hx[-2000:], test_y=hy[-2000:])
+        for i in range(num_workers * cap):
+            app.data_sink(i % num_workers, dict(enumerate(hx[i])),
+                          int(hy[i]))
+        app.run_serial(max_server_iterations=4)      # compile both paths
+        return app, {"done": 4}
+
+    arms = {"fused": build(False), "async": build(True)}
+
+    def timed(key: str) -> float:
+        app, state = arms[key]
+        t0 = time.perf_counter()
+        state["done"] += iters
+        app.run_serial(max_server_iterations=state["done"])
+        return iters / (time.perf_counter() - t0)
+
+    for k in arms:
+        timed(k)                                     # warm every arm
+    ab: dict = {k: [] for k in arms}
+    for _ in range(trials):
+        for k in arms:
+            ab[k].append(timed(k))
+    stats = {k: rate_stats(rs, round_to=2) for k, rs in ab.items()}
+    speedup = round(stats["async"]["median"]
+                    / max(stats["fused"]["median"], 1e-9), 3)
+    async_app = arms["async"][0]
+    eng = async_app.eval_engine
+    assert eng is not None and eng.lag_clocks == 0, \
+        "eval_ab: async arm ended with a backlog (speedup is deferral)"
+    engine_stats = eng.stats()
+    for _, (app, _) in arms.items():
+        app.close_logs()
+    return {
+        "iters_per_trial": iters,
+        "fused_iters_per_sec": stats["fused"],
+        "async_iters_per_sec": stats["async"],
+        "async_speedup": speedup,
+        "per_model_bitwise": bitwise,
+        "restart_bitwise": restart_bitwise,
+        "all_bitwise": bool(all(bitwise.values()) and restart_bitwise),
+        "final_lag_clocks": eng.lag_clocks,
+        "coalesce_widths": engine_stats["widths"],
+        "eval_dispatches": engine_stats["dispatches"],
+        "evals": engine_stats["evals"],
+    }
+
+
 def slab_ab(iters: int = 30, warm: int = 5) -> dict:
     """Incremental device-slab A/B (compress/slab.py,
     docs/PERFORMANCE.md): one message-driven worker at the reference
@@ -2394,6 +2551,9 @@ def main() -> None:
     # -- range-sharded server runtime A/B (docs/SHARDING.md) ---------------
     sharding = sharding_ab()
 
+    # -- async coalescing eval engine A/B (docs/EVALUATION.md) -------------
+    evalab = eval_ab()
+
     # -- incremental device slab A/B (docs/PERFORMANCE.md) -----------------
     slab = slab_ab()
     # slab-dtype-scaled roofline: same FLOPs, stored-bytes slab traffic —
@@ -2454,6 +2614,7 @@ def main() -> None:
                 "aggregation_ab": aggregation,
                 "wire_ab": wire,
                 "sharding_ab": sharding,
+                "eval_ab": evalab,
                 "slab_ab": slab,
                 "tiering_ab": tiering,
                 "telemetry_overhead": telemetry,
@@ -2534,6 +2695,8 @@ def main() -> None:
             "wire_updates_ratio": wire["updates_ratio_best"],
             "shard_n4_speedup": sharding["n4_speedup_best"],
             "shard_n1_bitwise": all(sharding["n1_bitwise"].values()),
+            "eval_async_speedup": evalab["async_speedup"],
+            "eval_bitwise": evalab["all_bitwise"],
             "slab_bytes_ratio_f32": slab[
                 "f32_bytes_ratio_full_over_incremental"],
             "slab_int8_hbm_ratio": slab["int8_device_bytes_ratio_vs_f32"],

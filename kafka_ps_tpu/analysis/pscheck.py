@@ -589,6 +589,17 @@ def _rules_for(path: Path) -> set:
         # of (worker, clock) for the N=1 bitwise pin to hold
         # (docs/AGGREGATION.md)
         rules.add("PS104")
+    if "evaluation" in parts and path.name == "engine.py":
+        # the async eval engine: submit/_dispatch run on the server's
+        # apply path and the engine thread respectively — a host sync
+        # there re-serializes the eval the engine exists to unfuse
+        # (PS102); its emission order must be a pure function of the
+        # submitted (theta, clock) sequence for the bitwise CSV
+        # contract, so no ambient clocks or entropy (PS104); and its
+        # metric calls must pass host ints only (PS106)
+        rules.add("PS102")
+        rules.add("PS104")
+        rules.add("PS106")
     if "telemetry" in parts and path.name in ("critpath.py",
                                               "profiler.py", "slo.py",
                                               "modelhealth.py",

@@ -73,6 +73,21 @@ def build_parser(include_server_flags: bool = True,
                         "LogisticRegressionTaskSpark.java:186; larger "
                         "values trade metric resolution for throughput "
                         "— eval dominates per-node wall-clock)")
+    p.add_argument("--eval-async", dest="eval_async", action="store_true",
+                   default=True,
+                   help="async coalescing eval engine (default ON, "
+                        "evaluation/engine.py): test-set evaluation "
+                        "leaves the server's apply critical path — a "
+                        "dedicated thread coalesces pending (theta, "
+                        "clock) snapshots into batched vmap dispatches "
+                        "and emits the SAME CSV rows in clock order "
+                        "(bitwise-identical to the fused path, "
+                        "docs/EVALUATION.md)")
+    p.add_argument("--no-eval-async", dest="eval_async",
+                   action="store_false",
+                   help="fuse evaluation back into the apply dispatch "
+                        "(the pre-engine behaviour; the A/B lever "
+                        "bench.py eval_ab measures)")
     p.add_argument("--max_iterations", type=int, default=0,
                    help="stop after this many server iterations "
                         "(0 = run until Ctrl-C, like the reference)")
@@ -371,6 +386,7 @@ def make_app_from_args(args, resuming: bool = False,
         stream=StreamConfig(time_per_event_ms=args.producer_time_per_event),
         use_pallas=args.pallas,
         eval_every=getattr(args, "eval_every", 1),
+        eval_async=getattr(args, "eval_async", True),
         use_gang=not getattr(args, "no_gang", False),
         compress=getattr(args, "compress", "none") or "none",
         slab_dtype=getattr(args, "slab_dtype", "f32") or "f32",
@@ -712,6 +728,8 @@ def run_with_args(args) -> int:
         ops.add_fsync_watchdog()
     if serve_engine is not None:
         ops.add_serving_watchdog(serve_engine)
+    if app.eval_engine is not None:
+        ops.add_eval_engine(app.eval_engine)   # /evalz detail row
     ops.start()
 
     metrics_file = getattr(args, "metrics_file", None)

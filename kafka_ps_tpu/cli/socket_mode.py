@@ -61,6 +61,7 @@ def _make_cfg(args):
         stream=StreamConfig(time_per_event_ms=getattr(
             args, "producer_time_per_event", 200)),
         eval_every=getattr(args, "eval_every", 1),
+        eval_async=getattr(args, "eval_async", True),
         use_pallas=getattr(args, "pallas", False),
         # the wire protocol has no gang-notice frame (runtime/serde.py),
         # and a notice crossing a socket could not promise anything
@@ -340,6 +341,14 @@ def run_server(args) -> int:
               flush=True)
     server.run_id = run_id
     server.membership_log = events_log   # before restore: it logs "resume"
+    # async coalescing eval plane (evaluation/engine.py): default-on,
+    # `--no-eval-async` restores the fused-eval apply programs
+    eval_engine = None
+    if cfg.eval_async and test_x is not None:
+        from kafka_ps_tpu.evaluation.engine import EvalEngine
+        eval_engine = server.attach_eval_engine(EvalEngine(
+            server.task, server.test_x, server.test_y, server._emit_eval,
+            telemetry=telemetry, tracer=tracer))
 
     from kafka_ps_tpu.log.durable_fabric import COLD_PARTITION_DIR
     from kafka_ps_tpu.runtime.messages import KeyRange
@@ -400,6 +409,8 @@ def run_server(args) -> int:
     ops = _make_ops(args, telemetry, role="server",
                     modelhealth=modelhealth)
     ops.add_gate_watchdog(server)
+    if eval_engine is not None:
+        ops.add_eval_engine(eval_engine)   # /evalz detail row
     if engine is not None:
         ops.add_serving_watchdog(engine)
     ops.start()
@@ -581,6 +592,8 @@ def run_server(args) -> int:
                              # accept/heartbeat/reader threads
         if engine is not None:
             engine.close()   # after the bridge: no reader can submit now
+        if eval_engine is not None:
+            eval_engine.close()   # drains pending evals into server.log
         if checkpoint_path:
             from kafka_ps_tpu.utils import checkpoint as ckpt
             ckpt.save(checkpoint_path, server)

@@ -109,6 +109,12 @@ class MLPTask:
     def evaluate(self, theta, x_test, y_test) -> metrics_mod.Metrics:
         return _evaluate(theta, x_test, y_test, cfg=self.cfg)
 
+    def evaluate_batch(self, thetas, x_test, y_test) -> metrics_mod.Metrics:
+        """Stacked eval over (k, P) thetas — see LogRegTask.evaluate_batch
+        (the async eval engine's coalesced dispatch)."""
+        return jax.vmap(
+            lambda t: self.evaluate(t, x_test, y_test))(thetas)
+
     def predict_logits(self, theta, x):
         """(B, F) → (B, C) class scores — the serving plane's forward
         pass (kafka_ps_tpu/serving/engine.py)."""

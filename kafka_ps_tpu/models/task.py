@@ -50,6 +50,9 @@ class MLTask(Protocol):
 
     def evaluate(self, theta, x_test, y_test) -> metrics_mod.Metrics: ...
 
+    def evaluate_batch(self, thetas, x_test, y_test) \
+            -> metrics_mod.Metrics: ...
+
     def predict_logits(self, theta, x) -> jax.Array: ...
 
 
@@ -76,6 +79,16 @@ class LogRegTask:
 
     def evaluate(self, theta, x_test, y_test) -> metrics_mod.Metrics:
         return metrics_mod.evaluate(theta, x_test, y_test, cfg=self.cfg)
+
+    def evaluate_batch(self, thetas, x_test, y_test) -> metrics_mod.Metrics:
+        """Stacked eval: (k, P) thetas against one test set -> Metrics
+        with (k,)-leading fields.  vmap of the SAME per-element program
+        as `evaluate`, so row i is bitwise-identical to
+        `evaluate(thetas[i], ...)` — the async eval engine's coalesced
+        dispatch rides on this (evaluation/engine.py, the vmap-of-kernel
+        construction the gang solvers proved, runtime/gang.py)."""
+        return jax.vmap(
+            lambda t: self.evaluate(t, x_test, y_test))(thetas)
 
     def predict_logits(self, theta, x):
         """(B, F) → (B, C+1) class scores — the serving plane's forward

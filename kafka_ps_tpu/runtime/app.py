@@ -108,6 +108,12 @@ class StreamingPSApp:
         # online serving plane (kafka_ps_tpu/serving/): built on demand
         # by enable_serving(); None keeps the app purely a trainer
         self.serving_engine = None
+        # async coalescing eval engine (kafka_ps_tpu/evaluation/engine.py):
+        # default-on when there is a test set — eval leaves the apply
+        # critical path.  `--no-eval-async` keeps the fused programs.
+        self.eval_engine = None
+        if cfg.eval_async and test_x is not None:
+            self.enable_async_eval()
         # rolling critical-path sampler, built lazily on first status()
         # heartbeat with telemetry on (telemetry/critpath.py)
         self._critpath = None
@@ -256,6 +262,33 @@ class StreamingPSApp:
         if self.serving_engine is not None:
             self.serving_engine.close()
 
+    # -- async eval plane (evaluation/engine.py, docs/EVALUATION.md) -------
+
+    def enable_async_eval(self):
+        """Attach the async coalescing eval engine to the server: eval-
+        cadence applies submit (theta, clock) snapshots to its bounded
+        queue instead of fusing the eval, and a dedicated thread
+        coalesces pending snapshots into batched vmap dispatches,
+        emitting CSV rows back through `server._emit_eval` in strict
+        clock order (bitwise-identical to the fused path).  Idempotent;
+        returns the engine (None without a test set)."""
+        if self.eval_engine is not None:
+            return self.eval_engine
+        if self.server.test_x is None:
+            return None
+        from kafka_ps_tpu.evaluation.engine import EvalEngine
+        self.eval_engine = self.server.attach_eval_engine(EvalEngine(
+            self.server.task, self.server.test_x, self.server.test_y,
+            self.server._emit_eval,
+            telemetry=self.telemetry, tracer=self.tracer))
+        return self.eval_engine
+
+    def close_eval(self) -> None:
+        """Drain pending evals and join the engine thread (holds jit'd
+        callables — same interpreter-exit discipline as serving)."""
+        if self.eval_engine is not None:
+            self.eval_engine.close()
+
     # -- tiered residency (kafka_ps_tpu/store/, docs/TIERING.md) -----------
 
     def enable_tiering(self, cold_dir: str | None = None):
@@ -319,6 +352,8 @@ class StreamingPSApp:
                     fabric_mod.GRADIENTS_TOPIC)},
             "buffers": [b.count for b in self.buffers],
         }
+        if self.eval_engine is not None:
+            out["eval_lag"] = self.eval_engine.lag_clocks
         if self.serving_engine is not None:
             s = self.serving_engine.stats()
             # cumulative count under a *_per_s key: StatusReporter
@@ -351,7 +386,11 @@ class StreamingPSApp:
 
     def flush_logs(self) -> None:
         """Force every deferred log line out (blocks on the device) —
-        drive loops call this on exit so callers see complete logs."""
+        drive loops call this on exit so callers see complete logs.
+        Pending async evals drain FIRST: their rows enter the server
+        sink's queue before the sink itself is flushed."""
+        if self.eval_engine is not None:
+            self.eval_engine.drain()
         for sink in (self.server.log, *{id(w.log): w.log
                                         for w in self.workers}.values()):
             flush = getattr(sink, "flush", None)
@@ -363,6 +402,7 @@ class StreamingPSApp:
         dispatch device fetches) and closes the wrapped file sinks.  The
         CLI calls this at exit so the process never finalizes with a
         live thread inside XLA (docs/TESTING.md)."""
+        self.close_eval()
         for sink in (self.server.log, *{id(w.log): w.log
                                         for w in self.workers}.values()):
             close = getattr(sink, "close", None)

@@ -207,6 +207,14 @@ class ServerNode:
         # theta stays bitwise-identical either way (the plane only
         # reads values the update already produced).
         self.modelhealth = NULL_MODEL_HEALTH
+        # async eval plane (evaluation/engine.py, --eval-async): when an
+        # EvalEngine is attached, eval-cadence applies shed the fused
+        # eval — the apply dispatch keeps the non-eval shape and the
+        # (theta, clock) pair is handed to the engine's queue instead
+        # (O(1): theta is an immutable alias by the replacement-only
+        # contract above).  None keeps the fused `_apply_full_eval`
+        # path — the --no-eval-async A/B arm, bitwise-identical CSV.
+        self.eval_engine = None
         # hierarchical aggregation (kafka_ps_tpu/agg/,
         # docs/AGGREGATION.md): stacked composites under BSP are
         # round-buffered here (clock -> {worker: delta}) and applied in
@@ -266,6 +274,31 @@ class ServerNode:
         apply path starts feeding it per-update diagnostics and eval
         metrics.  Detach by re-attaching NULL_MODEL_HEALTH."""
         self.modelhealth = plane
+
+    def attach_eval_engine(self, engine):
+        """Arm the async eval plane (evaluation/engine.py): eval-cadence
+        applies stop fusing the eval and submit (theta, clock) to the
+        engine instead; the engine calls `_emit_eval` back in strict
+        clock order.  Returns the engine (attach-and-keep idiom)."""
+        self.eval_engine = engine
+        return engine
+
+    def _emit_eval(self, clock: int, m) -> None:
+        """The ONE eval emission point — every fused path and the async
+        engine's thread funnel through here, so CSV rows, last_metrics
+        and the model-health plane see one sequence regardless of the
+        lever.  Schema: timestamp;partition;vectorClock;loss;fMeasure;
+        accuracy (ServerAppRunner.java:81); partition=-1 like the
+        reference, loss = real test loss (reference hardcodes -1).
+        Metric fields may be device futures — asynclog defers the
+        fetch; modelhealth's sampler floats its copies off-path."""
+        self.last_metrics = m
+        asynclog.submit_or_write(
+            self.log,
+            f"{int(time.time() * 1000)};-1;{clock};"
+            "{};{};{}", m.loss, m.f1, m.accuracy)
+        if self.modelhealth.enabled:
+            self.modelhealth.observe_eval(m.loss, m.f1)
 
     # -- bootstrap (ServerProcessor.java:75-87) ----------------------------
 
@@ -612,7 +645,13 @@ class ServerNode:
 
         want_eval = (msg.worker_id == 0 and self.test_x is not None
                      and msg.vector_clock % self.cfg.eval_every == 0)
+        # async lever: with an engine attached the apply keeps the
+        # non-eval program shape and the eval is deferred to the
+        # engine's queue after the dispatch
+        defer_eval = want_eval and self.eval_engine is not None
+        fused_eval = want_eval and not defer_eval
         m = None
+        deferred_theta = None
         with self.tracer.span("server.apply", worker=msg.worker_id,
                               clock=msg.vector_clock,
                               shard=self.shard_id, model=self._model):
@@ -631,9 +670,10 @@ class ServerNode:
                 # nested span keeps server.eval visible to --trace
                 # consumers even though the dispatch is shared)
                 if self.param_store is not None:
-                    m = self._apply_tiered(msg.values, want_eval,
-                                           msg.vector_clock)
-                elif want_eval:
+                    m, deferred_theta = self._apply_tiered(
+                        msg.values, fused_eval, defer_eval,
+                        msg.vector_clock)
+                elif fused_eval:
                     with self.tracer.span("server.eval",
                                           clock=msg.vector_clock):
                         self.theta, m = self._apply_full_eval(
@@ -664,24 +704,21 @@ class ServerNode:
                 self.theta = host
             self.iterations += 1
 
-        if want_eval:
+        if fused_eval:
             if m is None:            # partial-range splice path
                 with self.tracer.span("server.eval", clock=msg.vector_clock):
                     m = self.task.evaluate(jnp.asarray(self.theta),
                                            self.test_x, self.test_y)
                     self.tracer.count("dispatch.device")
-            self.last_metrics = m            # device futures; float() syncs
-            # schema: timestamp;partition;vectorClock;loss;fMeasure;accuracy
-            # (ServerAppRunner.java:81); partition=-1 like the reference,
-            # loss = real test loss (reference hardcodes -1)
-            asynclog.submit_or_write(
-                self.log,
-                f"{int(time.time() * 1000)};-1;{msg.vector_clock};"
-                "{};{};{}", m.loss, m.f1, m.accuracy)
-            if self.modelhealth.enabled:
-                # device futures enqueue by reference; the plane's
-                # sampler floats them off the apply path
-                self.modelhealth.observe_eval(m.loss, m.f1)
+            self._emit_eval(msg.vector_clock, m)
+        elif defer_eval:
+            # immutable alias hand-off; the tiered path surfaces the
+            # freshly-applied assembled vector so the engine never
+            # re-assembles pages (and the splice path's theta is a
+            # fresh host copy — also safe to alias)
+            self.eval_engine.submit(
+                self.theta if deferred_theta is None else deferred_theta,
+                msg.vector_clock)
 
         self.dispatch_release_set(
             self.workers_to_respond_to(msg.vector_clock, msg.worker_id))
@@ -689,8 +726,10 @@ class ServerNode:
 
         self.maybe_checkpoint()
 
-    def _apply_tiered(self, delta, want_eval: bool, clock: int):
-        """Full-range dense apply against the tiered store.
+    def _apply_tiered(self, delta, fused_eval: bool, defer_eval: bool,
+                      clock: int):
+        """Full-range dense apply against the tiered store.  Returns
+        (metrics, deferred_theta) — at most one is non-None.
 
         Non-eval: per-page `t_p + lr * d_p` dispatches.  `_apply_full`
         is pointwise, so page-sliced applies produce bitwise-identical
@@ -699,24 +738,33 @@ class ServerNode:
         warm/cold pages are materialized by the store (cold ones fault
         in from the log).
 
-        Eval: assemble once and run the SAME fused `_apply_full_eval`
-        program as the resident path, then scatter the result back —
-        identical jaxpr on identical input bits, so the CSV metrics
-        row matches the fully-resident run exactly."""
+        Fused eval: assemble once and run the SAME fused
+        `_apply_full_eval` program as the resident path, then scatter
+        the result back — identical jaxpr on identical input bits, so
+        the CSV metrics row matches the fully-resident run exactly.
+
+        Deferred eval (--eval-async): the same assemble-once structure,
+        but the apply keeps the non-eval program and the freshly-built
+        t2 is returned for the engine's queue — an immutable device
+        array the store's later page updates can never touch."""
         store = self.param_store
-        if want_eval:
+        if fused_eval:
             with self.tracer.span("server.eval", clock=clock):
                 t2, m = self._apply_full_eval(
                     jnp.asarray(store.assembled()), delta,
                     self.test_x, self.test_y)
                 store.replace_all(t2)
-            return m
+            return m, None
+        if defer_eval:
+            t2 = self._apply_full(jnp.asarray(store.assembled()), delta)
+            store.replace_all(t2)
+            return None, t2
         base = self._range.start
         for i, kr, value in store.pin_pages(self._range):
             lo, hi = kr.start - base, kr.end - base
             store.update_page(i, self._apply_full(jnp.asarray(value),
                                                   delta[lo:hi]))
-        return None
+        return None, None
 
     def _apply_sparse(self, msg, fid) -> None:
         """Apply a SparseDeltaMessage slice: theta[idx] += lr * vals as
@@ -957,11 +1005,13 @@ class ServerNode:
         self._pending_trace = fid
         want_eval = (0 in live and self.test_x is not None
                      and clock % self.cfg.eval_every == 0)
+        defer_eval = want_eval and self.eval_engine is not None
+        fused_eval = want_eval and not defer_eval
         m = None
         with self.tracer.span("server.apply", agg=comp.agg_id,
                               fan_in=len(live), clock=clock,
                               shard=self.shard_id, model=self._model):
-            if want_eval:
+            if fused_eval:
                 with self.tracer.span("server.eval", clock=clock):
                     self.theta, m = self._apply_full_eval(
                         jnp.asarray(self.theta), delta.values,
@@ -971,12 +1021,13 @@ class ServerNode:
                                               delta.values)
             self.tracer.count("dispatch.device")
             self.iterations += len(live)
-        if want_eval:
-            self.last_metrics = m
-            asynclog.submit_or_write(
-                self.log,
-                f"{int(time.time() * 1000)};-1;{clock};"
-                "{};{};{}", m.loss, m.f1, m.accuracy)
+        if fused_eval:
+            self._emit_eval(clock, m)
+        elif defer_eval:
+            # self.theta is replaced (never mutated) by later applies, so
+            # handing the alias to the engine's queue is safe — the
+            # snapshot-registry immutability contract (serving/snapshot.py)
+            self.eval_engine.submit(self.theta, clock)
         release: set = set()
         for worker in live:
             release |= self.workers_to_respond_to(clock, worker)
@@ -1050,7 +1101,8 @@ class ServerNode:
             return
 
         k = len(live)
-        eval_positions: list[int] = []
+        defer_eval = self.eval_engine is not None
+        eval_events: list[tuple[int, int]] = []   # (position, clock)
         release_events: list[tuple[int, list[tuple[int, int]]]] = []
         snap_clocks: dict[int, int] = {}
         for i, m in enumerate(live):
@@ -1064,7 +1116,7 @@ class ServerNode:
                 self.modelhealth.observe_update(m.worker_id, m.values)
             if (m.worker_id == 0 and self.test_x is not None
                     and m.vector_clock % self.cfg.eval_every == 0):
-                eval_positions.append(i)
+                eval_events.append((i, m.vector_clock))
             release = sorted(self.workers_to_respond_to(m.vector_clock,
                                                         m.worker_id))
             for w, c in release:
@@ -1079,10 +1131,18 @@ class ServerNode:
                     # processing the batch one message at a time
                     snap_clocks[i] = self.serving_clock()
         # releases at the last position see the final theta; earlier
-        # ones need their prefix returned from the jit
-        prefix_positions = tuple(sorted(
-            {i for i, _ in release_events if i < k - 1}))
-        fn = self._gang_apply_fn(k, tuple(eval_positions), prefix_positions)
+        # ones need their prefix returned from the jit.  Deferred evals
+        # turn their positions into prefix requests too — the engine
+        # evaluates the SAME prefix theta the fused program would have,
+        # it just does so off the apply path.
+        prefix_need = {i for i, _ in release_events if i < k - 1}
+        if defer_eval:
+            prefix_need |= {i for i, _ in eval_events if i < k - 1}
+            eval_positions: tuple = ()
+        else:
+            eval_positions = tuple(i for i, _ in eval_events)
+        prefix_positions = tuple(sorted(prefix_need))
+        fn = self._gang_apply_fn(k, eval_positions, prefix_positions)
         # same span name as the per-message path — one entry now covers
         # k chained applies (the `gang` arg distinguishes the two)
         with self.tracer.span("server.apply", gang=k,
@@ -1102,24 +1162,24 @@ class ServerNode:
         self.theta = final_theta
         prefix_theta = dict(zip(prefix_positions, prefixes))
         release_at = dict(release_events)
-        eval_set = set(eval_positions)
+        eval_at = dict(eval_events)
         mi = 0
         batch_released: list[tuple[int, int]] = []
         for i, m in enumerate(live):
-            if i in eval_set:
+            if i in eval_at and not defer_eval:
                 # the eval itself ran fused inside the batched apply;
                 # this span marks where its results enter the protocol
                 with self.tracer.span("server.eval",
                                       clock=m.vector_clock, fused=True):
                     met = metrics[mi]
                     mi += 1
-                    self.last_metrics = met
-                    asynclog.submit_or_write(
-                        self.log,
-                        f"{int(time.time() * 1000)};-1;{m.vector_clock};"
-                        "{};{};{}", met.loss, met.f1, met.accuracy)
-                    if self.modelhealth.enabled:
-                        self.modelhealth.observe_eval(met.loss, met.f1)
+                    self._emit_eval(m.vector_clock, met)
+            elif i in eval_at:
+                # deferred: hand the engine the prefix theta this clock
+                # observed — the exact array the fused program would have
+                # evaluated (final_theta for the last position)
+                self.eval_engine.submit(
+                    prefix_theta.get(i, final_theta), eval_at[i])
             rel = release_at.get(i)
             if rel:
                 theta_i = prefix_theta.get(i, final_theta)

@@ -315,6 +315,7 @@ class ShardedServerGroup:
         self.routers: dict[int, ShardRouter] = {}
         self._eval_clock = -1
         self._cut_publisher = None
+        self.eval_engine = None   # async eval plane (enable_async_eval)
         if num_shards == 1:
             node = ServerNode(cfg, fabric, test_x, test_y, log,
                               tracer=tracer, telemetry=telemetry)
@@ -421,6 +422,50 @@ class ShardedServerGroup:
             return
         self._cut_publisher.maybe_publish(self.snapshot_cut())
 
+    def enable_async_eval(self, telemetry=None, tracer=None):
+        """Attach the async coalescing eval plane (evaluation/engine.py).
+        N=1 arms the inner ServerNode — exactly the unsharded lever.
+        N>1 arms the GROUP's frontier eval: maybe_eval submits the
+        assembled theta (already a fresh host copy — immutable by
+        construction) instead of evaluating inline; the engine's thread
+        emits the same CSV rows in frontier-clock order.  Idempotent;
+        returns the engine (None without a test set)."""
+        if self.eval_engine is not None:
+            return self.eval_engine
+        if self.single is not None:
+            if self.single.test_x is None:
+                return None
+            from kafka_ps_tpu.evaluation.engine import EvalEngine
+            self.eval_engine = self.single.attach_eval_engine(EvalEngine(
+                self.single.task, self.single.test_x, self.single.test_y,
+                self.single._emit_eval,
+                telemetry=telemetry, tracer=tracer))
+            return self.eval_engine
+        if self.test_x is None:
+            return None
+        from kafka_ps_tpu.evaluation.engine import EvalEngine
+        self.eval_engine = EvalEngine(
+            self.task, self.test_x, self.test_y, self._emit_eval,
+            telemetry=telemetry, tracer=tracer)
+        return self.eval_engine
+
+    def close_eval(self) -> None:
+        """Drain pending evals and join the engine thread."""
+        if self.eval_engine is not None:
+            self.eval_engine.close()
+
+    def _emit_eval(self, clock: int, m) -> None:
+        """Group eval row writer — same schema as ServerNode._emit_eval
+        (timestamp;partition;vectorClock;loss;fMeasure;accuracy); shared
+        by the inline frontier eval and the async engine's thread."""
+        import time
+        from kafka_ps_tpu.utils import asynclog
+        asynclog.submit_or_write(
+            self.log,
+            # pscheck: disable=PS104 (CSV wall-clock column, not replay state)
+            f"{int(time.time() * 1000)};-1;{clock};"
+            "{};{};{}", m.loss, m.f1, m.accuracy)
+
     def maybe_eval(self) -> None:
         """Group-level online eval: when the WORKER-0 frontier (min
         across shards of worker 0's clock) crosses the eval cadence,
@@ -436,19 +481,16 @@ class ShardedServerGroup:
         if latest <= self._eval_clock or latest < 0:
             return
         self._eval_clock = latest
+        if self.eval_engine is not None:
+            # assembled_theta() is a fresh np.concatenate per call —
+            # the engine's queue owns this copy outright
+            self.eval_engine.submit(self.assembled_theta(), latest)
+            return
         import jax.numpy as jnp
         m = self.task.evaluate(jnp.asarray(self.assembled_theta()),
                                jnp.asarray(self.test_x),
                                jnp.asarray(self.test_y))
-        # same row schema as ServerNode.process (timestamp;partition;
-        # vectorClock;loss;fMeasure;accuracy)
-        import time
-        from kafka_ps_tpu.utils import asynclog
-        asynclog.submit_or_write(
-            self.log,
-            # pscheck: disable=PS104 (CSV wall-clock column, not replay state)
-            f"{int(time.time() * 1000)};-1;{latest};"
-            "{};{};{}", m.loss, m.f1, m.accuracy)
+        self._emit_eval(latest, m)
 
     # -- checkpointing -----------------------------------------------------
 

@@ -6,6 +6,14 @@ sent, stamped with the stable vector clock at that moment. Snapshots
 alias the server's device array — safe because ServerNode only ever
 *replaces* theta, never mutates it in place.
 
+That replace-never-mutate contract now has a second consumer: the
+async eval engine's pending queue (evaluation/engine.py) holds the
+same kind of theta aliases, keyed by WORKER-0 CADENCE clocks rather
+than the gate-release stable clocks published here — which is why the
+engine takes its snapshots directly from the apply path instead of
+tapping this registry (the two clock sequences differ, and the eval
+CSV's bitwise contract is defined over the cadence sequence).
+
 Readers (the prediction engine, any thread calling `latest`) take no
 lock: publication builds the complete Snapshot first and then swaps one
 reference, which is atomic under the GIL. A reader therefore always

@@ -32,6 +32,9 @@ The HTTP plane is stdlib-only (http.server on a named daemon thread):
     /modelz    model-health detail — per-worker contribution/divergence
                plus the drift verdict (--model-health; 404 when not
                armed)
+    /evalz     async eval-engine detail — queue depth, clock lag,
+               dispatch/coalesce counters (evaluation/engine.py; 404
+               when the engine is not attached, e.g. --no-eval-async)
 
 `OpsPlane` bundles recorder + panel + server lifecycle for the CLI
 roles (cli/run.py, cli/socket_mode.py): construct, add watchdogs,
@@ -191,12 +194,13 @@ class HealthServer:
 
     def __init__(self, port: int, *, panel: WatchdogPanel | None = None,
                  flight=None, telemetry=None, slo=None, modelhealth=None,
-                 host: str = "0.0.0.0"):
+                 eval_engine=None, host: str = "0.0.0.0"):
         self.panel = panel
         self.flight = flight if flight is not None else FLIGHT
         self.telemetry = telemetry
         self.slo = slo                  # SLOPlane (telemetry/slo.py)
         self.modelhealth = modelhealth  # ModelHealth (modelhealth.py)
+        self.eval_engine = eval_engine  # EvalEngine (evaluation/engine.py)
         plane = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -273,6 +277,21 @@ class HealthServer:
                         **plane_mh.detail(),
                     }).encode()
                     self._send(req, 200, body, "application/json")
+            elif url.path == "/evalz":
+                eng = self.eval_engine
+                if eng is None:
+                    self._send(req, 404,
+                               b'{"error": "async eval engine not '
+                               b'attached (--no-eval-async or no test '
+                               b'set)"}',
+                               "application/json")
+                else:
+                    body = json.dumps({
+                        "role": self.flight.role,
+                        "shard": self.flight.shard,
+                        **eng.stats(),
+                    }).encode()
+                    self._send(req, 200, body, "application/json")
             else:
                 self._send(req, 404, b'{"error": "unknown path"}',
                            "application/json")
@@ -314,6 +333,7 @@ class OpsPlane:
         self.profiler = None
         self.slo = None                 # SLOPlane via add_slo_plane
         self.modelhealth = None         # ModelHealth via add_modelhealth
+        self.eval_engine = None         # EvalEngine via add_eval_engine
         self._health_port = health_port
         self._telemetry = telemetry
         if not self.enabled:
@@ -393,6 +413,13 @@ class OpsPlane:
         self.add_watchdog("drift", threshold_s, beat_name="drift",
                           demand=plane.in_drift)
 
+    def add_eval_engine(self, engine) -> None:
+        """Surface the async eval engine on /evalz (queue depth, clock
+        lag, coalesce counters).  No watchdog: a lagging engine is a
+        throughput observation, not a liveness failure — the lag gauge
+        (`eval_lag_clocks`) is the alerting surface."""
+        self.eval_engine = engine
+
     def start(self) -> None:
         if not self.enabled:
             return
@@ -409,7 +436,8 @@ class OpsPlane:
                                        flight=self.flight,
                                        telemetry=self._telemetry,
                                        slo=self.slo,
-                                       modelhealth=self.modelhealth)
+                                       modelhealth=self.modelhealth,
+                                       eval_engine=self.eval_engine)
             print(f"health plane on port {self.health.port}",
                   file=sys.stderr, flush=True)
 

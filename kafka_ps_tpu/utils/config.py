@@ -120,6 +120,15 @@ class PSConfig:
     # average of worker deltas (ServerProcessor.java:36).
     learning_rate: float | None = None
     eval_every: int = 1   # server evaluates test metrics every iteration
+    # Async coalescing eval engine (evaluation/engine.py,
+    # docs/EVALUATION.md): take test-set evaluation off the server's
+    # apply critical path — a dedicated thread coalesces pending
+    # (theta, clock) snapshots into batched eval dispatches, emitting
+    # results in strict clock order.  Default ON; `--no-eval-async` is
+    # the A/B lever (eval CSV bitwise-identical either way).  The
+    # fused-BSP drive loop ignores it (its eval is already
+    # chunk-amortized, runtime/app._run_fused_loop).
+    eval_async: bool = True
     seed: int = 0
     # Use the Pallas fused local-update kernel (ops/fused_update.py) for
     # worker iterations; falls back to the XLA path off-TPU or when the

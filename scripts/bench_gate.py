@@ -143,6 +143,19 @@ METRICS = {
         "paths": [("detail", "paths", "wire_ab", "updates_ratio_best"),
                   ("wire_updates_ratio",)],
         "direction": "higher", "floor": 1.0, "rel": 0.25},
+    # async eval engine (docs/EVALUATION.md): the deferred plane must
+    # stay bitwise (CSV rows AND theta, durable-log restart included)
+    # and may never LOSE apply throughput to the fused path at
+    # eval_every=1 (floor 1.0; the relative band tracks the committed
+    # baselines' speedup once one exists for this device class)
+    "eval_bitwise": {
+        "paths": [("detail", "paths", "eval_ab", "all_bitwise"),
+                  ("eval_bitwise",)],
+        "must_be_true": True},
+    "eval_async_speedup": {
+        "paths": [("detail", "paths", "eval_ab", "async_speedup"),
+                  ("eval_async_speedup",)],
+        "direction": "higher", "floor": 1.0, "rel": 0.25},
     # absolute caps — the observability planes' cost contracts
     "telemetry_overhead_pct": {
         "paths": [("detail", "paths", "telemetry_overhead",

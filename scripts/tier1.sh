@@ -51,6 +51,18 @@
 # server eval CSV (timestamps stripped) must be bitwise-equal across
 # the coalescing lever (WIRE_SMOKE_OK).
 #
+# `scripts/tier1.sh --eval` runs the async-eval smoke leg
+# (docs/EVALUATION.md "Async evaluation"): a socket fleet of 1 server
+# (--bsp-order) + 1 aggregator relay + 2 member worker processes (4
+# logical workers) at eval_every=1 runs twice — the async coalescing
+# eval engine on (default) vs --no-eval-async (fused apply+eval).  In
+# EACH arm one member worker process is SIGKILL'd mid-run and
+# restarted (pending evals in the engine queue hold no durable state —
+# recovery is entirely the existing worker-state + relay-stash + READY
+# reissue machinery), and final theta AND the server eval CSV
+# (timestamps stripped) must be bitwise-equal across the eval lever
+# (EVAL_SMOKE_OK).
+#
 # `scripts/tier1.sh --load` runs the serving-load smoke leg: a child
 # training process serving over a socket (--serve --serve_port
 # --serve-queue) driven by THIS process's load generator — zero
@@ -779,6 +791,172 @@ assert zon["theta"].tobytes() == zoff["theta"].tobytes(), \
 assert csv_rows(cwd_on) == csv_rows(cwd_off) != [], \
     "coalesced eval CSV diverged from the --no-wire-coalesce arm"
 print(f"WIRE_SMOKE_OK workers=4 relay=1 iters={MAX_IT} "
+      f"kill=worker23+restart theta=bitwise csv=bitwise")
+EOF
+    exit $?
+fi
+
+if [[ "${1:-}" == "--eval" ]]; then
+    timeout -k 10 540 env JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+# the async-eval A/B (docs/EVALUATION.md "Async evaluation"): the SAME
+# training run — server <-- 1 relay <-- 2 member worker processes (4
+# logical workers), eval_every=1, deterministic knobs as in the --wire
+# leg (--bsp-order, --ready-rows = full partition) — once with the
+# async coalescing eval engine (the default) and once with
+# --no-eval-async (the fused _apply_full_eval programs).  In EACH arm
+# one member worker process is SIGKILL'd mid-run and restarted: the
+# engine holds no durable state (pending (theta, clock) snapshots die
+# with the process and the worker-state + relay-stash + READY-reissue
+# machinery re-derives the applied sequence), so final theta AND the
+# server eval CSV must match bitwise across the eval lever no matter
+# when the kill landed.
+root = tempfile.mkdtemp(prefix="kps-eval-")
+repo = os.getcwd()
+rng = np.random.default_rng(0)
+x = rng.normal(size=(192, 8)).astype(np.float32)
+y = (x[:, 0] > 0).astype(np.int32) + 1
+train, test = os.path.join(root, "train.csv"), os.path.join(root, "test.csv")
+for path, (xx, yy) in ((train, (x[:128], y[:128])),
+                       (test, (x[128:], y[128:]))):
+    with open(path, "w") as fh:
+        fh.write(",".join(f"f{i}" for i in range(8)) + ",Score\n")
+        for r, lab in zip(xx, yy):
+            fh.write(",".join(f"{v:.6f}" for v in r) + f",{lab}\n")
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+# 2000 rounds keep the training window open for seconds so the mid-run
+# kill lands while the gate is still cycling (the eval CSV is drained
+# asynchronously in BOTH arms — the on-disk row count lags the clock)
+MAX_IT = 2000
+READY = 32          # 128 rows / 4 workers: full-partition gating
+common = ["--num_workers", "4", "--num_features", "8",
+          "--num_classes", "2", "--max_iterations", str(MAX_IT),
+          "--eval_every", "1"]
+
+def server_proc(cwd, port, evalflag):
+    return subprocess.Popen(
+        [sys.executable, "-m", "kafka_ps_tpu.cli.server_runner",
+         "--listen", str(port), "--bsp-order", "-c", "0",
+         "-training", train, "-test", test, "-p", "1", "--logging",
+         "--checkpoint", os.path.join(cwd, "ckpt.npz"),
+         *evalflag, *common],
+        env=env, cwd=cwd, stderr=subprocess.PIPE,
+        stdout=subprocess.DEVNULL, text=True)
+
+def worker_proc(cwd, wids, aport):
+    return subprocess.Popen(
+        [sys.executable, "-m", "kafka_ps_tpu.cli.worker_runner",
+         "--aggregate", f"127.0.0.1:{aport}", "--worker_ids", wids,
+         "-test", test, "-min", "8", "-max", "32",
+         "--ready-rows", str(READY),
+         "--checkpoint", os.path.join(cwd, "job.npz"),
+         "--state_every", "0.2", *common],
+        env=env, cwd=cwd, stderr=subprocess.PIPE,
+        stdout=subprocess.DEVNULL, text=True)
+
+def agg_proc(cwd, sport, aport):
+    return subprocess.Popen(
+        [sys.executable, "-m", "kafka_ps_tpu.cli.agg_runner",
+         "--connect", f"127.0.0.1:{sport}", "--listen", str(aport),
+         "--agg-id", "0", "--worker_ids", "0,1,2,3", *common],
+        env=env, cwd=cwd, stderr=subprocess.PIPE,
+        stdout=subprocess.DEVNULL, text=True)
+
+def finish(procs, deadline_s=240):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if all(p.poll() is not None for p in procs.values()):
+            break
+        time.sleep(0.25)
+    else:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for name, p in procs.items():
+            print(f"== {name} rc={p.poll()}\n{p.stderr.read()[-4000:]}",
+                  file=sys.stderr)
+        raise SystemExit("fleet did not finish in time")
+    bad = []
+    for name, p in procs.items():
+        err = p.stderr.read()
+        if p.returncode != 0:
+            print(f"== {name} rc={p.returncode}\n{err[-4000:]}",
+                  file=sys.stderr)
+            bad.append(name)
+    assert not bad, f"{bad} failed"
+
+def csv_rows(cwd):
+    # column 0 is the wall-clock timestamp — the only legal difference
+    with open(os.path.join(cwd, "logs-server.csv")) as fh:
+        return [";".join(ln.split(";")[1:]) for ln in fh.read().splitlines()]
+
+def run_arm(tag, evalflag):
+    cwd = os.path.join(root, tag)
+    os.makedirs(cwd, exist_ok=True)
+    sport, aport = free_port(), free_port()
+    sp = server_proc(cwd, sport, evalflag)
+    rp = agg_proc(cwd, sport, aport)
+    w01 = worker_proc(cwd, "0,1", aport)
+    w23 = worker_proc(cwd, "2,3", aport)
+    # SIGKILL member process 2,3 once the server shows real progress
+    csv_path = os.path.join(cwd, "logs-server.csv")
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            with open(csv_path) as fh:
+                n = sum(1 for _ in fh) - 1
+        except OSError:
+            n = 0
+        if n >= 16:
+            break
+        for name, p in (("server", sp), ("relay", rp), ("w23", w23)):
+            if p.poll() is not None:
+                print(p.stderr.read(), file=sys.stderr)
+                raise SystemExit(f"{tag}: {name} exited before the kill")
+        time.sleep(0.05)
+    else:
+        raise SystemExit(f"{tag}: server never made progress")
+    os.kill(w23.pid, signal.SIGKILL)
+    w23.wait()
+    time.sleep(0.5)
+    # restart: durable state restores the 32-row windows, READY fires
+    # immediately, the stalled round completes; any evals the async
+    # engine still held at kill time were never durable — the engine
+    # re-derives them from the re-applied clock sequence
+    w23b = worker_proc(cwd, "2,3", aport)
+    finish({"server": sp, "relay": rp, "worker01": w01,
+            "worker23-restarted": w23b})
+    return cwd
+
+cwd_async = run_arm("eval-async", [])
+cwd_fused = run_arm("eval-fused", ["--no-eval-async"])
+
+za = np.load(os.path.join(cwd_async, "ckpt.npz"))
+zf = np.load(os.path.join(cwd_fused, "ckpt.npz"))
+assert int(za["iterations"]) >= MAX_IT <= int(zf["iterations"])
+assert za["theta"].tobytes() == zf["theta"].tobytes(), \
+    "async-eval theta diverged from the --no-eval-async arm"
+assert csv_rows(cwd_async) == csv_rows(cwd_fused) != [], \
+    "async-eval CSV diverged from the --no-eval-async arm"
+print(f"EVAL_SMOKE_OK workers=4 relay=1 iters={MAX_IT} eval_every=1 "
       f"kill=worker23+restart theta=bitwise csv=bitwise")
 EOF
     exit $?

@@ -1,0 +1,9 @@
+"""PS102 positive fixture (scoped: evaluation/engine.py): a host sync
+inside the engine's dispatch path re-serializes the eval the engine
+exists to unfuse."""
+import numpy as np
+
+
+class Engine:
+    def _dispatch(self, batch):
+        return np.asarray(batch[0])

@@ -1,0 +1,13 @@
+"""PS102 negative fixture: submit enqueues by reference (O(1), no
+host materialization); syncs outside the handler set are fine."""
+import numpy as np
+
+
+def load_test_set(path):
+    # one-time construction, not a per-snapshot handler
+    return np.asarray([[1.0], [2.0]])
+
+
+class Engine:
+    def submit(self, theta, clock):
+        self.pending.append((theta, clock))   # alias, never a copy

@@ -1,0 +1,9 @@
+"""PS104 positive fixture (scoped: evaluation/engine.py): a wall-clock
+read in the engine — emission must be a pure function of the submitted
+(theta, clock) sequence for the bitwise CSV contract."""
+import time
+
+
+def stamp_result(result):
+    result.ts = time.time()
+    return result

@@ -1,0 +1,7 @@
+"""PS104 negative fixture: monotonic reads (thread pacing, idle-exit
+bookkeeping) are not replay state and stay allowed."""
+import time
+
+
+def idle_for(since):
+    return time.monotonic() - since

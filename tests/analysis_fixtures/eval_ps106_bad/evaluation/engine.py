@@ -1,0 +1,7 @@
+"""PS106 positive fixture (scoped: evaluation/engine.py): fetching a
+device value inside a metric call's arguments blocks the engine thread
+on the very dispatch it just issued."""
+
+
+def record_width(hist, width_metric):
+    hist.observe(float(width_metric))

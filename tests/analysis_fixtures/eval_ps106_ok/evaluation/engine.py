@@ -1,0 +1,6 @@
+"""PS106 negative fixture: host-integer metric arguments the engine
+already owns (batch width, queue depth) record without syncing."""
+
+
+def record_width(hist, batch):
+    hist.observe(len(batch))

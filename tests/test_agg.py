@@ -281,6 +281,7 @@ def _run_direct(consistency, iters, compress="none"):
             progressed = True
         stalled = 0 if progressed else stalled + 1
         assert stalled < 100, "direct pump deadlocked"
+    app.flush_logs()    # drain deferred async evals (last_metrics reads)
     return app
 
 
@@ -328,6 +329,7 @@ def _run_aggregated(consistency, iters, compress="none",
                 app.server.process(dup)
         stalled = 0 if progressed else stalled + 1
         assert stalled < 100, "aggregated pump deadlocked"
+    app.flush_logs()    # drain deferred async evals (last_metrics reads)
     return app
 
 

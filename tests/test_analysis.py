@@ -53,6 +53,9 @@ def _findings(relpath: str):
     ("ps104_wire_bad/runtime/wire.py", "PS104"),
     ("runtime/wire_ps105_bad.py", "PS105"),
     ("runtime/wire_ps106_bad.py", "PS106"),
+    ("eval_ps102_bad/evaluation/engine.py", "PS102"),
+    ("eval_ps104_bad/evaluation/engine.py", "PS104"),
+    ("eval_ps106_bad/evaluation/engine.py", "PS106"),
 ])
 def test_positive_fixture_triggers_exactly_once(relpath, rule):
     found = _findings(relpath)
@@ -88,6 +91,9 @@ def test_positive_fixture_triggers_exactly_once(relpath, rule):
     "ps104_wire_ok/runtime/wire.py",
     "runtime/wire_ps105_ok.py",
     "runtime/wire_ps106_ok.py",
+    "eval_ps102_ok/evaluation/engine.py",
+    "eval_ps104_ok/evaluation/engine.py",
+    "eval_ps106_ok/evaluation/engine.py",
 ])
 def test_negative_fixture_stays_clean(relpath):
     assert _findings(relpath) == []

@@ -41,7 +41,8 @@ def test_known_blocks_is_the_schema(bench_module):
     blocks = bench_module.KNOWN_BLOCKS
     assert len(blocks) == len(set(blocks))
     assert all(isinstance(b, str) and b for b in blocks)
-    assert "serving_load" in blocks            # this PR's block
+    assert "serving_load" in blocks
+    assert "eval_ab" in blocks                 # this PR's block
 
 
 def test_committed_doc_has_every_known_block(bench_module, committed_doc):
@@ -70,6 +71,22 @@ def test_serving_load_block_shape(committed_doc):
     assert over["shed"] > 0 and over["errors"] == 0
     assert over["p99_ms"] is not None
     assert over["p99_ms"] <= load["deadline_ms"]
+
+
+def test_eval_ab_block_shape(committed_doc):
+    evalab = committed_doc["detail"]["paths"].get("eval_ab")
+    if evalab is None:
+        pytest.skip("committed doc predates eval_ab")
+    for key in ("fused_iters_per_sec", "async_iters_per_sec",
+                "async_speedup", "per_model_bitwise", "restart_bitwise",
+                "all_bitwise", "final_lag_clocks", "coalesce_widths"):
+        assert key in evalab, key
+    # the bitwise contract covers all three consistency models AND the
+    # durable-log restart; the gate's must_be_true key folds them
+    assert set(evalab["per_model_bitwise"]) == {"0", "2", "-1"}
+    assert evalab["all_bitwise"] is True
+    # the acceptance gauge: the async arm may not end with a backlog
+    assert evalab["final_lag_clocks"] == 0
 
 
 def test_summary_line_stays_one_short_line(committed_doc):
