@@ -270,6 +270,7 @@ class AggregatorRelay:
         for w, (residual, clock, blob) in state.items():
             arrays[f"residual_{w}"] = residual
             arrays[f"clock_{w}"] = np.asarray([clock], dtype=np.int64)
+            # pscheck: disable=PS204 (checkpoint stash of opaque message blobs via savez, not a wire-frame decode)
             arrays[f"msg_{w}"] = np.frombuffer(blob, dtype=np.uint8)
         tmp = self._ckpt + ".tmp"
         with open(tmp, "wb") as f:

@@ -1,12 +1,26 @@
 """Static + runtime invariant checking for kafka_ps_tpu.
 
-- ``pscheck``   — AST analyzer (rules PS100-PS105), stdlib-only;
-  CLI: ``python -m kafka_ps_tpu.analysis kafka_ps_tpu/ [--json]``.
+- ``pscheck``   — per-file AST analyzer (rules PS100-PS106), stdlib-only.
+- ``psverify``  — the combined driver: pscheck plus the whole-program
+  passes, behind ``python -m kafka_ps_tpu.analysis kafka_ps_tpu/
+  [--json] [--lock-coverage edges.json]``:
+
+  * ``threadck`` — thread-ownership/race analysis (PS201/PS202):
+    lockset intersection over every shared ``self.<attr>`` access
+    site, with ``# guarded-by:`` / ``# owned-by:`` annotations.
+  * ``lockflow`` — static held→acquired lock graph, Tarjan cycles
+    (PS203), and the static-vs-runtime coverage diff.
+  * ``wireck``  — encode/decode wire-schema cross-check (PS204).
+  * PS107 — useless-suppression audit over the whole inventory.
+
+- ``program``   — the shared whole-program AST/symbol model the three
+  passes consume.
 - ``lockgraph`` — runtime lock-acquisition-order recorder (OrderedLock /
   OrderedCondition) with deadlock-cycle detection, reported at pytest
   session end by ``kafka_ps_tpu.analysis.pytest_plugin``.
 
-See docs/ANALYSIS.md for the rule catalog and suppression syntax.
+See docs/ANALYSIS.md for the rule catalog, suppression syntax and
+annotation grammar.
 
 This package must stay importable without jax: the CLI runs in the
 tier-1 ``--analyze`` leg before any accelerator runtime is touched.
@@ -14,4 +28,14 @@ tier-1 ``--analyze`` leg before any accelerator runtime is touched.
 
 from kafka_ps_tpu.analysis import lockgraph, pscheck  # noqa: F401
 
-__all__ = ["lockgraph", "pscheck"]
+__all__ = ["lockgraph", "pscheck", "psverify", "program",
+           "threadck", "lockflow", "wireck"]
+
+
+def __getattr__(name):
+    # the whole-program passes are imported lazily so that importing
+    # the package (e.g. for OrderedLock) stays as cheap as before
+    if name in __all__:
+        import importlib
+        return importlib.import_module(f"{__name__}.{name}")
+    raise AttributeError(name)

@@ -1,8 +1,9 @@
-"""``python -m kafka_ps_tpu.analysis`` — run pscheck over the repo."""
+"""``python -m kafka_ps_tpu.analysis`` — run the psverify suite
+(pscheck + threadck + lockflow + wireck) over the repo."""
 
 import sys
 
-from kafka_ps_tpu.analysis.pscheck import main
+from kafka_ps_tpu.analysis.psverify import main
 
 if __name__ == "__main__":
     sys.exit(main())

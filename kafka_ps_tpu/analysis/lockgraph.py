@@ -67,20 +67,27 @@ class LockGraph:
     edge a->b means some thread acquired b while holding a."""
 
     edges: dict[tuple[str, str], _Edge] = field(default_factory=dict)
-    names: set = field(default_factory=set)
+    # lock name -> source location of its FIRST acquisition ("file:line
+    # in func"), so exported edges can say where each endpoint lives —
+    # the lockflow coverage diff uses this to point at unexercised edges
+    names: dict[str, str] = field(default_factory=dict)
     acquisitions: int = 0
     _mu: threading.Lock = field(default_factory=threading.Lock)
 
     def note(self, name: str, held: list[str]) -> None:
         with self._mu:
             self.acquisitions += 1
-            self.names.add(name)
+            first = name not in self.names
+            if first:
+                self.names[name] = ""      # claimed; site filled below
             new = [h for h in held if h != name and (h, name) not in self.edges]
-        if not new:
+        if not (new or first):
             return
         site = _call_site()
         tname = threading.current_thread().name
         with self._mu:
+            if first and not self.names[name]:
+                self.names[name] = site
             for h in new:
                 self.edges.setdefault(
                     (h, name), _Edge(h, name, site, tname))
@@ -112,8 +119,11 @@ class LockGraph:
         order a dead process had actually exercised."""
         with self._mu:
             edges = list(self.edges.values())
+            names = dict(self.names)
         return [{"src": e.src, "dst": e.dst, "site": e.site,
-                 "thread": e.thread} for e in edges]
+                 "thread": e.thread,
+                 "src_first": names.get(e.src, ""),
+                 "dst_first": names.get(e.dst, "")} for e in edges]
 
     def summary(self) -> str:
         with self._mu:

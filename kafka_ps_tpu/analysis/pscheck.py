@@ -78,7 +78,8 @@ import sys
 from dataclasses import dataclass, field
 from pathlib import Path
 
-__all__ = ["RULES", "Finding", "Report", "analyze_source", "analyze_path", "main"]
+__all__ = ["RULES", "Finding", "Report", "analyze_source", "analyze_path",
+           "scan_source", "apply_suppressions", "main"]
 
 RULES: dict[str, str] = {
     "PS100": "suppression without a written justification",
@@ -209,23 +210,49 @@ class Report:
         self.findings.extend(other.findings)
         self.files += other.files
 
+    def by_rule(self) -> dict:
+        """Per-rule counts — the suppression inventory, diffable in CI."""
+        out: dict = {}
+        for f in self.findings:
+            row = out.setdefault(
+                f.rule, {"total": 0, "suppressed": 0, "unsuppressed": 0})
+            row["total"] += 1
+            row["suppressed" if f.suppressed else "unsuppressed"] += 1
+        return dict(sorted(out.items()))
+
     def to_json(self) -> dict:
         return {
             "files": self.files,
             "counts": {"total": len(self.findings),
                        "suppressed": len(self.suppressed),
                        "unsuppressed": len(self.unsuppressed)},
+            "by_rule": self.by_rule(),
             "findings": [f.to_json() for f in self.findings],
         }
 
 
 # -- suppression parsing ---------------------------------------------------
 
+def _comment_lines(source: str):
+    """(lineno, comment_text) for every real COMMENT token — a
+    suppression spelled inside a string/docstring (e.g. the syntax
+    example in this very module) is documentation, not a directive.
+    Falls back to raw lines when the file doesn't tokenize."""
+    import io
+    import tokenize
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        yield from enumerate(source.splitlines(), start=1)
+
+
 def _parse_suppressions(source: str, path: str):
     """-> ({line: {code: reason|None}}, [PS100 findings])"""
     table: dict[int, dict[str, str | None]] = {}
     ps100: list[Finding] = []
-    for lineno, line in enumerate(source.splitlines(), start=1):
+    for lineno, line in _comment_lines(source):
         m = SUPPRESS_RE.search(line)
         if not m:
             continue
@@ -614,28 +641,46 @@ def _rules_for(path: Path) -> set:
     return rules
 
 
-def analyze_source(source: str, path: str) -> Report:
-    p = Path(path)
-    rules = _rules_for(p)
-    rep = Report(files=1)
+def scan_source(source: str, path: str):
+    """Raw per-file scan for the psverify driver: rule findings with
+    suppression NOT yet applied, plus the suppression table.
+
+    -> (findings, table, ps100_findings); on a parse failure the
+    findings list holds the single synthetic PS100 and table is {}.
+    """
     table, ps100 = _parse_suppressions(source, path)
-    rep.findings.extend(ps100)
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
-        rep.findings.append(Finding(
-            "PS100", path, e.lineno or 0, f"file does not parse: {e.msg}"))
-        return rep
-    checker = _Checker(path, rules)
+        return ([Finding("PS100", path, e.lineno or 0,
+                         f"file does not parse: {e.msg}")], {}, ps100)
+    checker = _Checker(path, _rules_for(Path(path)))
     checker.visit(tree)
-    for f in checker.findings:
+    return (checker.findings, table, ps100)
+
+
+def apply_suppressions(findings, table) -> set:
+    """Mark findings suppressed from `table` ({line: {code: reason}});
+    returns the set of (line, code) table entries that matched — the
+    complement is what PS107 (useless suppression) reports on."""
+    used: set = set()
+    for f in findings:
         for line in (f.line, f.line - 1):
             entry = table.get(line)
             if entry and f.rule in entry:
                 f.suppressed = True
                 f.reason = entry[f.rule]
+                used.add((line, f.rule))
                 break
-    rep.findings.extend(checker.findings)
+    return used
+
+
+def analyze_source(source: str, path: str) -> Report:
+    rep = Report(files=1)
+    findings, table, ps100 = scan_source(source, path)
+    rep.findings.extend(ps100)
+    apply_suppressions(findings, table)
+    rep.findings.extend(findings)
     rep.findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return rep
 

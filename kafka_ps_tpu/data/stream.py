@@ -96,6 +96,7 @@ class CsvStreamProducer:
         # default pacing waits on the stop event, so stop() interrupts a
         # sleep instantly; an injected sleep (tests) is called directly
         self._sleep = sleep if sleep is not time.sleep else None
+        # pscheck: disable=PS201 (producer-thread counter; read for end-of-run reporting after join)
         self.rows_sent = 0
         self.finished = threading.Event()
         self.stopped = threading.Event()

@@ -144,11 +144,17 @@ class EvalEngine:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # host-side counters for /evalz (telemetry/health.py)
+        # guarded-by: _cv (submit writes hold the cv; reads are int snapshots)
         self._submitted_clock = -1
+        # pscheck: disable=PS201 (dispatch-side monotonic clock; lag/stats reads tolerate a one-batch-stale snapshot)
         self._evaluated_clock = -1
+        # pscheck: disable=PS201 (telemetry counter; racing poll drivers at worst undercount a stat)
         self._dispatches = 0
+        # pscheck: disable=PS201 (telemetry counter; racing poll drivers at worst undercount a stat)
         self._evals = 0
+        # pscheck: disable=PS201 (telemetry histogram; racing poll drivers at worst undercount a stat)
         self._width_counts: dict[int, int] = {}
+        # pscheck: disable=PS201 (jit cache; a racing rebuild traces the same function - idempotent)
         self._programs: dict[int, object] = {}
 
     # -- producer side (the server's apply path) ---------------------------
@@ -324,7 +330,7 @@ class EvalEngine:
         self._stop.set()
         with self._cv:
             self._cv.notify_all()
-        t = self._thread
+            t = self._thread
         if t is not None and t is not threading.current_thread():
             t.join(timeout=60.0)
         while self.poll():           # leftovers after a timed-out drain

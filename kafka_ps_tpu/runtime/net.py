@@ -389,11 +389,13 @@ class ServerBridge:
         # negotiation (docstring above) lands in `_codec_of`, and sends
         # to a none-negotiated peer strip the encoded payload in _send
         self.codec = codec if codec is not None else CODEC_SPEC_NONE
+        # guarded-by: _lock (HELLO writes hold the state lock; send-path reads are GIL-atomic dict gets)
         self._codec_of: dict[socket.socket, CodecSpec] = {}
         self._tracer = tracer or NULL_TRACER
         self._telemetry = telemetry or NULL_TELEMETRY
         # per-connection trace negotiation (module docstring): True iff
         # the peer offered AND this side's tracer is on
+        # guarded-by: _lock (HELLO writes hold the state lock; send-path reads are GIL-atomic)
         self._trace_of: dict[socket.socket, bool] = {}
         # pre-resolved metric children: one dict lookup + one leaf-lock
         # inc per frame on the hot path (null metrics when telemetry off)
@@ -404,10 +406,12 @@ class ServerBridge:
         self._wire_lock = OrderedLock("ServerBridge.wire")
         self._listener = socket.create_server((host, port))
         self.port = self._listener.getsockname()[1]
+        # guarded-by: _lock (registration holds the cv; routing reads are GIL-atomic dict gets)
         self._conn_of: dict[int, socket.socket] = {}   # worker -> conn
         self._ready: set[int] = set()
         self._lock = OrderedLock("ServerBridge.state", reentrant=True)
         self._cv = threading.Condition(self._lock)
+        # pscheck: disable=PS201 (wrap publishes the fabric before any traffic can reference it - attach-before-serve)
         self._fabric: fabric_mod.Fabric | None = None
         self._stop = threading.Event()
         self._send_lock: dict[socket.socket, OrderedLock] = {}
@@ -415,11 +419,14 @@ class ServerBridge:
         # and ship them in scatter-gather batches from a dedicated
         # writer thread; off = the classic one-sendall-per-frame path
         self._coalesce = bool(coalesce)
+        # guarded-by: _lock (accept-loop writes hold the state lock; send-path reads are GIL-atomic)
         self._writer_of: dict[socket.socket, FrameWriter] = {}
+        # guarded-by: _lock (registered under the lock; the reader's per-frame store is GIL-atomic and the heartbeat tolerates an interval of staleness)
         self._last_recv: dict[socket.socket, float] = {}
         self.on_disconnect = None   # Callable[[list[int]], None]
         self.on_hello = None        # Callable[[list[int]], None]
         self.on_ready = None        # Callable[[int], None]
+        # pscheck: disable=PS201 (attach_serving publishes the engine before predict frames can arrive)
         self._serving = None        # PredictionEngine (attach_serving)
         # same-host shared-memory fast path (serving/shm.py): offered
         # per connection on a HELLO that requests it, only when enabled
@@ -430,13 +437,16 @@ class ServerBridge:
         # into T_WEIGHTS_AGG frames, and their disconnects are relay
         # restarts, not member failures — on_disconnect is suppressed
         self._agg_conns: set[socket.socket] = set()
+        # guarded-by: _lock (offer/teardown hold the state lock; reads are GIL-atomic)
         self._shm_of: dict[socket.socket, object] = {}
         self._shm_threads: list[threading.Thread] = []
         self._m_shm = self._telemetry.counter("serving_dispatch_mode",
                                               mode="shm")
+        # pscheck: disable=PS201 (failure-path counter; a racing increment can only undercount telemetry)
         self.dropped_sends = 0      # frames lost to dead connections
         self._hb_interval = heartbeat_interval
         self._hb_timeout = heartbeat_timeout
+        # guarded-by: _lock (accept loop swaps the list under the state lock; close() joins after the listener is down)
         self._reader_threads: list[threading.Thread] = []
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="kps-net-accept")
@@ -729,18 +739,22 @@ class ServerBridge:
                 force_close(conn)
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._send_lock[conn] = OrderedLock("ServerBridge.send")
-            if self._coalesce:
-                self._writer_of[conn] = FrameWriter(
-                    conn, telemetry=self._telemetry)
-            self._last_recv[conn] = time.monotonic()
+            with self._cv:
+                # per-connection registries are written under the state
+                # lock; the heartbeat/send threads iterate them
+                self._send_lock[conn] = OrderedLock("ServerBridge.send")
+                if self._coalesce:
+                    self._writer_of[conn] = FrameWriter(
+                        conn, telemetry=self._telemetry)
+                self._last_recv[conn] = time.monotonic()
             t = threading.Thread(target=self._reader, args=(conn,),
                                  daemon=True, name="kps-net-reader")
             t.start()
             # prune finished readers so worker churn over a long
             # rebalance run doesn't accumulate dead Thread objects
-            self._reader_threads = [r for r in self._reader_threads
-                                    if r.is_alive()] + [t]
+            with self._cv:
+                self._reader_threads = [r for r in self._reader_threads
+                                        if r.is_alive()] + [t]
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self._hb_interval):
@@ -783,13 +797,18 @@ class ServerBridge:
                     peer = _read_codec_trailer(payload, 8 + 8 * n)
                     negotiated = (self.codec if peer == self.codec
                                   else CODEC_SPEC_NONE)
-                    self._codec_of[conn] = negotiated
                     # trace negotiation: ON iff the peer offered AND our
                     # tracer is on (old peers send no flag -> off)
                     trace_on = (_read_trace_flag(
                         payload, 8 + 8 * n + _CODEC_TRAILER.size)
                         and self._tracer.enabled)
-                    self._trace_of[conn] = trace_on
+                    with self._cv:
+                        # negotiation results land under the state lock
+                        # BEFORE T_CONFIG goes out: once the peer sees
+                        # CONFIG it may talk coded frames, and the send
+                        # paths read these dicts from other threads
+                        self._codec_of[conn] = negotiated
+                        self._trace_of[conn] = trace_on
                     # shm negotiation: the offer rides CONFIG only when
                     # the peer asked — worker handshakes stay
                     # byte-identical to every earlier version
@@ -1073,6 +1092,7 @@ class WorkerBridge:
         # set by a mid-stream GOODBYE config: the run ended cleanly,
         # the EOF that follows is not a crash (read before
         # `disconnected` by the aggregated worker supervisor)
+        # pscheck: disable=PS201 (monotonic bool set by the reader thread; pollers tolerate one stale read)
         self.run_over = False
         self.server_run_id: int | None = None
         payload = (struct.pack(f"<q{len(self.worker_ids)}q",
@@ -1216,6 +1236,7 @@ class WorkerBridge:
                 else:
                     super().send(topic, key, message)
 
+        # pscheck: disable=PS201 (make_fabric publishes before run_reader starts - the handshake orders it)
         self.fabric = BridgedFabric()
         return self.fabric
 
@@ -1327,6 +1348,7 @@ class WorkerBridge:
                     off = 8
                     rows = []
                     for _ in range(nrows):
+                        # pscheck: disable=PS204 (legacy framing: old servers length-prefixed each row with an i32; the current encoder is columnar and never packs this)
                         (blen,) = struct.unpack_from("<i", payload, off)
                         off += 4
                         row = serde.from_bytes(payload[off:off + blen])

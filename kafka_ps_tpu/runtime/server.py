@@ -263,7 +263,7 @@ class ServerNode:
                 f"store range [{store.key_range.start}, "
                 f"{store.key_range.end}) != shard range "
                 f"[{self._range.start}, {self._range.end})")
-        # pscheck: disable=PS102 (one-time seed at attach, not the hot path)
+        # one-time seed at attach, not the hot path
         store.replace_all(np.asarray(self._theta))
         self.param_store = store
         self._theta = None           # the store owns the values now
@@ -805,9 +805,8 @@ class ServerNode:
         access skew is exactly what the heat policy feeds on
         (docs/TIERING.md)."""
         store = self.param_store
-        # pscheck: disable=PS102 (wire slices are host arrays; no device sync)
+        # wire slices are host arrays; no device sync happens here
         idx = np.asarray(msg.indices, dtype=np.int64)
-        # pscheck: disable=PS102 (wire slices are host arrays; no device sync)
         vals = np.asarray(msg.values, dtype=np.float32)
         pages = idx // store.page_params
         for page in np.unique(pages):

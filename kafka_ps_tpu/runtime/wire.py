@@ -134,6 +134,7 @@ class FrameWriter:
         self._deadline = float(send_deadline)
         self._q: deque = deque()          # (header, payload) pairs
         self._qbytes = 0
+        # guarded-by: _lock (writers hold the queue lock; the dead property is a lock-free monotonic-bool peek)
         self._dead = False
         self._closing = False
         self._lock = OrderedLock("FrameWriter.queue")

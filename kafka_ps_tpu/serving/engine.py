@@ -178,6 +178,7 @@ class PredictionEngine:
         # admission bookkeeping: depth counters must be exact (they gate
         # sheds), so they move under one leaf lock, never nested
         self._admission = OrderedLock("PredictionEngine.admission")
+        # guarded-by: _admission (queue_depth/stats reads are lock-free gauge snapshots)
         self._depth = 0            # total admitted-but-unserved requests
         # inline bypass serves currently running on caller threads:
         # while one is in flight, new arrivals take the queue — that
@@ -185,16 +186,23 @@ class PredictionEngine:
         # model's demand signal and flips the engine back to batching
         self._bypassing = 0
         self._ewma_batch_s: float | None = None
+        # guarded-by: _admission (add_model writes hold it; steady-state reads are GIL-atomic dict gets)
         self._tenants: dict[int, _Tenant] = {
             0: _Tenant(0, task, registry, self.max_batch)}
         self.latency = LatencyRecorder()
         # cumulative counters; status() exposes requests as a *_per_s key
+        # guarded-by: _admission (stats reads are lock-free snapshots)
         self.requests = 0
+        # guarded-by: _admission (stats reads are lock-free snapshots)
         self.batches = 0          # device dispatches (== jit calls)
+        # guarded-by: _admission (stats reads are lock-free snapshots)
         self.batched_rows = 0     # rows that made it into a dispatch
+        # guarded-by: _admission (stats reads are lock-free snapshots)
         self.rejections = 0       # staleness rejections
         self.sheds = 0            # admission-control sheds (typed)
+        # guarded-by: _admission (stats reads are lock-free snapshots)
         self.bypasses = 0         # requests served on the fast path
+        # guarded-by: _admission (stats reads are lock-free snapshots)
         self.errors = 0
         self._closed = False
         self._thread = threading.Thread(
@@ -457,7 +465,8 @@ class PredictionEngine:
             try:
                 policy.check(snap, req.bound, now)
             except policy.StalenessError as err:
-                self.rejections += 1
+                with self._admission:
+                    self.rejections += 1
                 self.tracer.count("serving.staleness_rejections")
                 if self.telemetry.enabled:
                     self._m_rejections.inc()
@@ -469,7 +478,8 @@ class PredictionEngine:
         try:
             labels, confs = self._dispatch(tenant, snap, live, mode, avail)
         except Exception as err:  # noqa: BLE001 — fail the rows, not the loop
-            self.errors += 1
+            with self._admission:
+                self.errors += 1
             for req in live:
                 self._finish(req, err)
             return
@@ -483,7 +493,7 @@ class PredictionEngine:
         if self.telemetry.enabled:
             self._m_batch_size.observe(len(live))
         for i, req in enumerate(live):
-            # pscheck: disable=PS102 (labels/confs are host arrays by here)
+            # labels/confs are host arrays by here
             self._finish(req, Prediction(int(labels[i]), float(confs[i]),
                                          snap.vector_clock, snap.wall_time))
 

@@ -133,9 +133,12 @@ class TieredParamStore:
         # measured counters the bench/stats read (host ints, no device
         # sync anywhere near them)
         self.pins = {"hot": 0, "warm": 0, "cold": 0}
+        # guarded-by: _lock (rebalance writes hold the residency lock; stats reads are snapshots)
         self.promotions = 0
+        # guarded-by: _lock (rebalance writes hold the residency lock; stats reads are snapshots)
         self.demotions = 0
         self.faults = 0          # cold pages materialized on demand
+        # guarded-by: _lock (rebalance writes hold the residency lock; stats reads are snapshots)
         self.rebalances = 0
         self._m_pins = {t: telemetry.counter("param_tier_pins_total",
                                              tier=t)

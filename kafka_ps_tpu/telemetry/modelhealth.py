@@ -129,6 +129,7 @@ class ModelHealth:
             "modelhealth_deferred_total",
             help_text="device deltas observed by reference (resolved "
                       "sampled, off the hot path)", **self._labels)
+        # pscheck: disable=PS201 (gauge-child cache filled outside the lock by PS106 design; racers store registry-deduped children, GIL-atomic)
         self._per_worker: dict[int, tuple] = {}   # id -> (share, div)
         self._lock = OrderedLock("telemetry.modelhealth")
         # EWMA aggregate direction (unit host vector) + per-worker state
@@ -136,6 +137,7 @@ class ModelHealth:
         self._w_norm_ewma: dict[int, float] = {}
         self._w_divergence: dict[int, float] = {}
         self._w_updates: dict[int, int] = {}
+        # guarded-by: _lock (ingest holds it; poll's lock-free read is a monotonic count)
         self.updates = 0
         self.last_norm = 0.0
         self.last_cosine = 1.0

@@ -89,7 +89,9 @@ class SamplingProfiler:
     def __init__(self, hz: float = 100.0, max_stacks: int = 512):
         self.hz = float(hz)
         self.max_stacks = int(max_stacks)
+        # guarded-by: _lock (sampler writes hold it; stats reads are snapshots)
         self.samples = 0
+        # guarded-by: _lock (sampler writes hold it; stats reads are snapshots)
         self.dropped = 0                 # samples folded into (other)
         self._counts: dict[tuple[str, tuple[str, ...]], int] = {}
         self._tokens: dict[object, str] = {}     # code object -> token

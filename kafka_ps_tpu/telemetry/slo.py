@@ -105,8 +105,11 @@ class SLOPlane:
         self.flight = flight if flight is not None else FLIGHT
         self.sample_every_s = sample_every_s
         self.slos: list[SLO] = []
+        # guarded-by: _lock (add populates before start - the add-before-start contract)
         self._history: dict[str, deque] = {}
+        # pscheck: disable=PS201 (registered by add before the sampler starts; the sampler only reads)
         self._gauges: dict[tuple[str, str], object] = {}
+        # pscheck: disable=PS201 (sampler is the sole writer; burning reads tolerate one interval of staleness)
         self._burning: dict[str, bool] = {}
         self._lock = OrderedLock("telemetry.slo")
         self._stop = threading.Event()

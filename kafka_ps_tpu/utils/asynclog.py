@@ -278,7 +278,8 @@ class DeferredSink:
     def close(self) -> None:
         self._stop.set()
         self._wake.set()
-        t = self._thread
+        with self._lock:
+            t = self._thread
         if t is not None and t is not threading.current_thread():
             # the drain thread may be mid device-fetch; a process must
             # never finalize while it is inside XLA (SIGABRT) — wait it

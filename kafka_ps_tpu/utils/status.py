@@ -44,6 +44,7 @@ class StatusReporter:
         self._thread: threading.Thread | None = None
         # per-key (last value, last timestamp) for every derived-rate
         # key — `iters` and the `*_per_s` family share the mechanism
+        # pscheck: disable=PS201 (rate scratch for the status line; a torn read skews one printed rate)
         self._last_counts: dict[str, tuple[float, float]] = {}
 
     def start(self) -> "StatusReporter":

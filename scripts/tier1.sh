@@ -124,13 +124,99 @@
 set -o pipefail
 
 if [[ "${1:-}" == "--analyze" ]]; then
-    python -m kafka_ps_tpu.analysis kafka_ps_tpu/ || exit 1
+    # 1) drive the real threaded subsystems under an isolated recorder
+    #    and dump the runtime lock-order edges the static graph is
+    #    diffed against (the test_migrated_production_locks driver)
+    EDGES=$(mktemp /tmp/kps_lock_edges.XXXXXX.json)
+    trap 'rm -f "$EDGES"' EXIT
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python - "$EDGES" <<'EOF' || exit 1
+import json
+import sys
+import tempfile
+import threading
+
+from kafka_ps_tpu.analysis import lockgraph
+from kafka_ps_tpu.data.buffer import SlidingBuffer
+from kafka_ps_tpu.runtime import fabric as fabric_mod
+from kafka_ps_tpu.serving.snapshot import SnapshotRegistry
+from kafka_ps_tpu.utils.asynclog import DeferredSink
+from kafka_ps_tpu.utils.config import BufferConfig
+from kafka_ps_tpu.utils.csvlog import CsvLogSink
+
+with tempfile.TemporaryDirectory() as td:
+    with lockgraph.isolated() as g:
+        fab = fabric_mod.Fabric()
+        buf = SlidingBuffer(4, BufferConfig(min_size=16, max_size=64))
+        reg = SnapshotRegistry()
+        csv = CsvLogSink(td + "/t.csv", header="a;b")
+        sink = DeferredSink(csv, drain_interval=0.01)
+
+        def producer():
+            for i in range(50):
+                fab.send(fabric_mod.WEIGHTS_TOPIC, 0, i)
+                buf.add([float(i)] * 4, i % 2)
+                reg.publish([float(i)], vector_clock=i)
+                sink(f"{i};x")
+
+        def consumer():
+            for _ in range(50):
+                fab.poll_blocking(fabric_mod.WEIGHTS_TOPIC, 0, timeout=2)
+                buf.snapshot()
+                _ = reg.latest
+
+        ts = [threading.Thread(target=f) for f in (producer, consumer)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        sink.close()
+        csv.close()
+        cycles = g.cycles()
+        edges = g.export_edges()
+if cycles:
+    print(f"runtime lock-order cycle: {cycles}", file=sys.stderr)
+    sys.exit(1)
+with open(sys.argv[1], "w", encoding="utf-8") as f:
+    json.dump({"edges": edges}, f)
+print(f"runtime lock edges recorded: {len(edges)}")
+EOF
+    # 2) psverify: pscheck + threadck + lockflow + wireck + PS107 over
+    #    the package, diffed against the runtime edges; hard-fails on
+    #    ANY unsuppressed finding
+    REPORT=$(mktemp /tmp/kps_psverify.XXXXXX.json)
+    trap 'rm -f "$EDGES" "$REPORT"' EXIT
+    python -m kafka_ps_tpu.analysis kafka_ps_tpu/ --json \
+        --lock-coverage "$EDGES" > "$REPORT"
+    STATUS=$?
+    python - "$REPORT" "$STATUS" <<'EOF' || exit 1
+import json
+import sys
+
+from kafka_ps_tpu.analysis import psverify
+
+data = json.load(open(sys.argv[1], encoding="utf-8"))
+uns = data["counts"]["unsuppressed"]
+sup = data["counts"]["suppressed"]
+if uns or int(sys.argv[2]) != 0:
+    for f in data["findings"]:
+        if not f["suppressed"]:
+            print(f"{f['path']}:{f['line']}: {f['rule']} {f['message']}")
+    print(f"psverify: {uns} unsuppressed findings", file=sys.stderr)
+    sys.exit(1)
+cov = data.get("lock_coverage") or {}
+print(f"lock coverage: {cov.get('common', 0)} edges exercised at "
+      f"runtime, {len(cov.get('static_only', []))} static-only, "
+      f"{len(cov.get('runtime_only', []))} runtime-only")
+for e in cov.get("runtime_only", []):
+    print(f"  runtime-only {e['src']} -> {e['dst']} @ {e.get('site', '?')}")
+print(f"ANALYZE_OK rules={len(psverify.RULES)} findings={uns} "
+      f"suppressed={sup}")
+EOF
     if command -v ruff >/dev/null 2>&1; then
         ruff check . || exit 1
     else
-        echo "ruff not installed; skipped (pscheck gate ran)"
+        echo "ruff not installed; skipped (psverify gate ran)"
     fi
-    echo ANALYZE_OK
     exit 0
 fi
 

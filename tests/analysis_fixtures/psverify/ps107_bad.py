@@ -1,0 +1,8 @@
+"""PS107 positive fixture: the disable entry outlived its finding —
+the wall-clock read it once excused is long gone."""
+import time
+
+
+def pace():
+    # pscheck: disable=PS104 (stale: the wall-clock read moved away)
+    return time.monotonic()

@@ -1,0 +1,7 @@
+"""PS107 negative fixture: the suppression still matches a live
+finding (a PS104 in a replay-critical path), so it is not stale."""
+import time
+
+
+def stamp():
+    return time.time()  # pscheck: disable=PS104 (display-only column)

@@ -1,0 +1,18 @@
+"""PS201 positive fixture: a counter shared between the pump thread
+and external callers, with no lock on either side and no annotation."""
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._run, name="fx-pump")
+        self._t.start()
+
+    def _run(self):
+        for _ in range(3):
+            self.count += 1
+
+    def read(self):
+        return self.count

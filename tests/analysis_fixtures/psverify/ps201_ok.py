@@ -1,0 +1,20 @@
+"""PS201 negative fixture: the same shared counter, every access site
+under the one lock."""
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._run, name="fx-pump")
+        self._t.start()
+
+    def _run(self):
+        for _ in range(3):
+            with self._lock:
+                self.count += 1
+
+    def read(self):
+        with self._lock:
+            return self.count

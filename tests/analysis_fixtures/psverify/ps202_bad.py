@@ -1,0 +1,18 @@
+"""PS202 positive fixture: a guarded-by annotation naming a lock that
+no access site ever holds — the claim is dead, not just optimistic."""
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: _lock (claimed, but no site ever holds it)
+        self.total = 0
+        self._t = threading.Thread(target=self._run, name="fx-meter")
+        self._t.start()
+
+    def _run(self):
+        self.total += 1
+
+    def read(self):
+        return self.total

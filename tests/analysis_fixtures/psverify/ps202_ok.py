@@ -1,0 +1,19 @@
+"""PS202 negative fixture: guarded-by holds — the writer takes the
+named lock, the annotation blesses the lock-free snapshot read."""
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: _lock (writers hold it; reads are int snapshots)
+        self.total = 0
+        self._t = threading.Thread(target=self._run, name="fx-meter")
+        self._t.start()
+
+    def _run(self):
+        with self._lock:
+            self.total += 1
+
+    def read(self):
+        return self.total

@@ -1,0 +1,17 @@
+"""PS202 positive fixture (owned-by form): the cursor is declared
+owned by the tail thread, but a public method reads it from callers."""
+import threading
+
+
+class Tail:
+    def __init__(self):
+        # owned-by: fx-tail (the tail thread owns the cursor)
+        self.cursor = 0
+        self._t = threading.Thread(target=self._run, name="fx-tail")
+        self._t.start()
+
+    def _run(self):
+        self.cursor += 1
+
+    def peek(self):
+        return self.cursor

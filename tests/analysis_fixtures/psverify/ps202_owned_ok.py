@@ -1,0 +1,18 @@
+"""PS202 negative fixture (owned-by form): every cursor access really
+does happen on the declared owner thread."""
+import threading
+
+
+class Tail:
+    def __init__(self):
+        # owned-by: fx-tail (the tail thread owns the cursor)
+        self.cursor = 0
+        self._t = threading.Thread(target=self._run, name="fx-tail")
+        self._t.start()
+
+    def _run(self):
+        self.cursor += 1
+        self._step()
+
+    def _step(self):
+        self.cursor += 1

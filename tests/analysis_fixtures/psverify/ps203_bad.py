@@ -1,0 +1,20 @@
+"""PS203 positive fixture: A-then-B on one path, B-then-A on the
+other.  Tests drive ONLY `forward`, so the runtime lockgraph records a
+single consistent edge and stays silent — the static pass still proves
+the inversion from the never-exercised `backward`."""
+from kafka_ps_tpu.analysis.lockgraph import OrderedLock
+
+A = OrderedLock("fx203.A")
+B = OrderedLock("fx203.B")
+
+
+def forward():
+    with A:
+        with B:
+            return True
+
+
+def backward():
+    with B:
+        with A:
+            return True

@@ -1,0 +1,17 @@
+"""PS203 negative fixture: both paths agree on the A-before-B order."""
+from kafka_ps_tpu.analysis.lockgraph import OrderedLock
+
+A = OrderedLock("fx203ok.A")
+B = OrderedLock("fx203ok.B")
+
+
+def forward():
+    with A:
+        with B:
+            return True
+
+
+def also_forward():
+    with A:
+        with B:
+            return False

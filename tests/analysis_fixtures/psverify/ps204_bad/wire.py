@@ -1,0 +1,12 @@
+"""PS204 positive fixture: the encoder writes an (i64, u32) header,
+the decoder reads only the i64 — the second field drifted away."""
+import struct
+
+
+def encode(seq, n):
+    return struct.pack("<qI", seq, n)
+
+
+def decode(buf):
+    (seq,) = struct.unpack("<q", buf[:8])
+    return seq

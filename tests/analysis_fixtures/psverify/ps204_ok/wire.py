@@ -1,0 +1,11 @@
+"""PS204 negative fixture: encode and decode agree on the header."""
+import struct
+
+
+def encode(seq, n):
+    return struct.pack("<qI", seq, n)
+
+
+def decode(buf):
+    seq, n = struct.unpack("<qI", buf[:12])
+    return seq, n

@@ -313,3 +313,111 @@ def test_migrated_production_locks_are_cycle_free(tmp_path):
         csv.close()
         assert g.cycles() == []
         assert g.acquisitions > 0
+
+
+# -- psverify: the whole-program passes (PS201-PS204, PS107) ---------------
+
+def _verify(relpath: str):
+    from kafka_ps_tpu.analysis import psverify
+    rep, _ = psverify.analyze([FIXTURES / "psverify" / relpath])
+    return rep
+
+
+@pytest.mark.parametrize("relpath,rule", [
+    ("ps201_bad.py", "PS201"),
+    ("ps202_bad.py", "PS202"),
+    ("ps202_owned_bad.py", "PS202"),
+    ("ps203_bad.py", "PS203"),
+    ("ps204_bad/wire.py", "PS204"),
+    ("ps107_bad.py", "PS107"),
+])
+def test_psverify_positive_fixture_triggers_exactly_once(relpath, rule):
+    rep = _verify(relpath)
+    assert [f.rule for f in rep.findings] == [rule], \
+        [f.render() for f in rep.findings]
+    assert not rep.findings[0].suppressed
+
+
+@pytest.mark.parametrize("relpath", [
+    "ps201_ok.py",
+    "ps202_ok.py",
+    "ps202_owned_ok.py",
+    "ps203_ok.py",
+    "ps204_ok/wire.py",
+    "ps107_ok/log/stamp.py",
+])
+def test_psverify_negative_fixture_stays_clean(relpath):
+    rep = _verify(relpath)
+    assert rep.unsuppressed == [], [f.render() for f in rep.unsuppressed]
+    # in particular: a suppression that matches a live finding is not
+    # flagged stale
+    assert not [f for f in rep.findings if f.rule == "PS107"]
+
+
+def test_repo_is_clean_under_all_passes():
+    """The tier-1 gate, extended: pscheck AND the whole-program passes
+    find nothing unsuppressed in production code, and every suppression
+    carries a written reason."""
+    from kafka_ps_tpu.analysis import psverify
+    rep, _ = psverify.analyze([PACKAGE])
+    assert rep.unsuppressed == [], [f.render() for f in rep.unsuppressed]
+    for f in rep.suppressed:
+        assert f.reason, f.render()
+
+
+def test_json_reports_per_rule_suppressed_counts():
+    rep = _verify("ps107_ok/log/stamp.py")
+    data = rep.to_json()
+    assert data["by_rule"]["PS104"] == {
+        "total": 1, "suppressed": 1, "unsuppressed": 0}
+
+
+def test_static_cycle_detected_while_runtime_stays_silent():
+    """The inversion lives on a path the process never takes: the
+    runtime recorder cannot see it, the static pass must."""
+    import importlib.util
+
+    from kafka_ps_tpu.analysis import lockflow, program
+
+    fixture = FIXTURES / "psverify" / "ps203_bad.py"
+    prog = program.build([fixture])
+    assert [f.rule for f in lockflow.check(prog)] == ["PS203"]
+
+    with lockgraph.isolated() as g:
+        spec = importlib.util.spec_from_file_location("fx203", fixture)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.forward()        # ONLY the consistent path runs
+        assert g.cycles() == []
+        runtime = g.export_edges()
+    assert [(e["src"], e["dst"]) for e in runtime] \
+        == [("fx203.A", "fx203.B")]
+    # satellite: runtime edges carry first-acquisition source locations
+    for e in runtime:
+        assert "ps203_bad.py" in e["src_first"], e
+        assert "ps203_bad.py" in e["dst_first"], e
+
+    cov = lockflow.coverage_diff(prog, runtime)
+    assert cov["common"] == 1
+    assert [(e["src"], e["dst"]) for e in cov["static_only"]] \
+        == [("fx203.B", "fx203.A")]
+    assert cov["runtime_only"] == []
+
+
+def test_psverify_cli_reports_lock_coverage(tmp_path):
+    import subprocess
+
+    fixture = FIXTURES / "psverify" / "ps203_ok.py"
+    edges = [{"src": "fx203ok.A", "dst": "fx203ok.B",
+              "site": "x.py:1", "thread": "t", "src_first": "",
+              "dst_first": ""}]
+    dump = tmp_path / "edges.json"
+    dump.write_text(json.dumps({"edges": edges}), encoding="utf-8")
+    proc = subprocess.run(
+        [sys.executable, "-m", "kafka_ps_tpu.analysis", str(fixture),
+         "--json", "--lock-coverage", str(dump)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    cov = rep["lock_coverage"]
+    assert cov["common"] == 1 and cov["runtime_only"] == []
